@@ -1,0 +1,24 @@
+"""opsagent_tpu: a TPU-native Kubernetes AI agent framework.
+
+Two halves, one wire format (OpenAI chat.completions + tool_calls):
+
+- The agent/host layer (``agent/``, ``tools/``, ``workflows/``, ``server/``,
+  ``cli/``, ``k8s/``, ``llm/``, ``utils/``) reproduces the capability surface of
+  the reference Go agent (myysophia/OpsAgent, see SURVEY.md): a ReAct loop over
+  kubectl/python/trivy/jq tools, a JWT-protected REST API, and a CLI.
+
+- The TPU serving engine (``models/``, ``ops/``, ``parallel/``, ``serving/``)
+  replaces the reference's remote LLM providers (reference pkg/llms/openai.go)
+  with an in-tree JAX/XLA inference engine: tensor-parallel sharding over a
+  device mesh, paged KV cache with a Pallas kernel, continuous batching, and
+  on-device constrained decoding of function-call JSON, reachable through a
+  ``tpu://`` model provider.
+
+JAX is imported lazily: the agent layer works without touching the accelerator.
+"""
+
+__version__ = "0.1.0"
+
+# CLI-facing version string (reference: cmd/kube-copilot/server.go:29 uses
+# "v1.0.2" while pkg/handlers/version.go:8 says "v1.0.18"; we use one).
+VERSION = "v0.1.0"

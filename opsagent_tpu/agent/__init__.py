@@ -1,0 +1,4 @@
+from .react import assistant, assistant_with_config
+from .funcall import AgentFunction, run_function_agent
+
+__all__ = ["assistant", "assistant_with_config", "AgentFunction", "run_function_agent"]

@@ -1,0 +1,192 @@
+"""The ReAct agent core: the central tool-dispatch loop.
+
+Capability parity with the reference's pkg/assistants/simple.go (the live
+~330 lines): ``Assistant`` (simple.go:287) / ``AssistantWithConfig``
+(simple.go:292) run a JSON-formatted ReAct loop against a chat model, with the
+reference's full robustness ladder:
+
+- unparseable FIRST reply is treated as the final answer (simple.go:375-381);
+- iteration cap (simple.go:407-412);
+- a ``final_answer`` is accepted only when it is not template/placeholder text
+  AND at least one observation has been made (simple.go:414-419);
+- tool failures become observations ("Tool X failed with error ...",
+  simple.go:455), unknown tools likewise (simple.go:481);
+- observations are truncated to 1024 tokens (simple.go:495);
+- the updated ToolPrompt is marshaled back as a **user** message
+  (simple.go:497-501) — this wire quirk is preserved because the serving
+  engine's prefix cache keys on it;
+- an unparseable mid-loop reply triggers one summarization turn and a
+  best-effort ``final_answer`` extraction (simple.go:558-599).
+
+The loop returns the model's final raw reply; consumers (the execute
+handler's 4-stage parse ladder, the CLI) extract ``final_answer`` themselves.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from ..llm.client import ChatClient
+from ..llm.tokens import constrict_messages, constrict_prompt, get_token_limits
+from ..tools import ToolPrompt, get_tools, ToolError
+from ..utils.jsonrepair import extract_field
+from ..utils.logger import get_logger
+from ..utils.perf import get_perf_stats, trace_func
+from .prompts import SUMMARIZE_PROMPT
+
+log = get_logger("agent")
+
+OBSERVATION_TOKEN_LIMIT = 1024
+
+_PLACEHOLDER = re.compile(r"<[^<>\n]{0,80}>")
+
+
+def is_template_value(value: str) -> bool:
+    """Reject placeholder/template final answers (reference
+    simple.go:624-657): empty, contains ``<final_answer``-style markers or any
+    ``<...>`` placeholder, or is implausibly short."""
+    v = value.strip()
+    if not v:
+        return True
+    if "<final_answer" in v or "final_answer>" in v:
+        return True
+    if _PLACEHOLDER.search(v):
+        return True
+    if len(v) < 10:
+        return True
+    return False
+
+
+def assistant(
+    model: str,
+    messages: list[dict[str, Any]],
+    max_tokens: int = 2048,
+    count_tokens: bool = False,
+    verbose: bool = False,
+    max_iterations: int = 10,
+) -> tuple[str, list[dict[str, Any]]]:
+    """Run the ReAct loop with credentials from the environment."""
+    return assistant_with_config(
+        model, messages, max_tokens, count_tokens, verbose, max_iterations, "", ""
+    )
+
+
+def assistant_with_config(
+    model: str,
+    messages: list[dict[str, Any]],
+    max_tokens: int = 2048,
+    count_tokens: bool = False,
+    verbose: bool = False,
+    max_iterations: int = 10,
+    api_key: str = "",
+    base_url: str = "",
+) -> tuple[str, list[dict[str, Any]]]:
+    """Run the ReAct loop; returns (final raw reply, full chat history).
+
+    ``messages`` must hold the system prompt and the user instruction; the
+    list is extended in place with every turn so callers can reconstruct the
+    tool history afterwards (as the execute handler does).
+    """
+    stop = trace_func("agent.loop")
+    try:
+        return _react_loop(
+            model, messages, max_tokens, count_tokens, verbose,
+            max_iterations, api_key, base_url,
+        )
+    finally:
+        stop()
+
+
+def _react_loop(
+    model: str,
+    chat_history: list[dict[str, Any]],
+    max_tokens: int,
+    count_tokens: bool,
+    verbose: bool,
+    max_iterations: int,
+    api_key: str,
+    base_url: str,
+) -> tuple[str, list[dict[str, Any]]]:
+    ps = get_perf_stats()
+    client = ChatClient(api_key=api_key, base_url=base_url)
+    tools = get_tools()
+    # A completion budget >= the model's context window would leave zero room
+    # for the prompt (and the constrictor would evict history to nothing).
+    max_tokens = min(max_tokens, max(256, get_token_limits(model) // 2))
+
+    def call(msgs: list[dict[str, Any]]) -> str:
+        sendable = constrict_messages(msgs, model, max_tokens) if count_tokens else msgs
+        with ps.timer("agent.llm_turn"):
+            return client.chat(model, max_tokens, sendable)
+
+    reply = call(chat_history)
+    chat_history.append({"role": "assistant", "content": reply})
+    if verbose:
+        log.info("initial reply: %s", reply[:500])
+
+    try:
+        prompt = ToolPrompt.from_json(reply)
+    except ValueError:
+        # Unparseable first reply: treat the raw text as the final answer.
+        return reply, chat_history
+
+    iterations = 0
+    while True:
+        iterations += 1
+        if iterations > max_iterations:
+            log.warning("iteration cap %d reached", max_iterations)
+            return reply, chat_history
+
+        if prompt.final_answer and not is_template_value(prompt.final_answer):
+            if prompt.observation.strip():
+                return reply, chat_history
+            if verbose:
+                log.info("final_answer offered without observation; continuing")
+
+        name = prompt.action.name.strip()
+        tool_input = prompt.action.input
+        if name and name in tools:
+            if verbose:
+                log.info("tool %s input=%r", name, tool_input[:200])
+            try:
+                with ps.timer(f"agent.tool.{name}"):
+                    observation = tools[name](tool_input)
+            except ToolError as e:
+                observation = (
+                    f"Tool {name} failed with error {e}. "
+                    "Considering refine the inputs for the tool."
+                )
+            except Exception as e:  # noqa: BLE001 - tool bugs become observations
+                observation = (
+                    f"Tool {name} failed with error {e}. "
+                    "Considering refine the inputs for the tool."
+                )
+        elif name:
+            observation = (
+                f"Tool {name} is not available. Considering switch to other tools."
+            )
+        else:
+            observation = (
+                "No action was specified. Specify a tool action or give the "
+                "final_answer."
+            )
+
+        prompt.observation = constrict_prompt(observation, OBSERVATION_TOKEN_LIMIT)
+        chat_history.append({"role": "user", "content": prompt.to_json()})
+
+        reply = call(chat_history)
+        chat_history.append({"role": "assistant", "content": reply})
+        if verbose:
+            log.info("iteration %d reply: %s", iterations, reply[:500])
+
+        try:
+            prompt = ToolPrompt.from_json(reply)
+        except ValueError:
+            # Mid-loop unparseable reply: one summarization turn, then a
+            # best-effort final_answer extraction.
+            chat_history.append({"role": "user", "content": SUMMARIZE_PROMPT})
+            reply = call(chat_history)
+            chat_history.append({"role": "assistant", "content": reply})
+            final = extract_field(reply, "final_answer") or reply
+            return final, chat_history

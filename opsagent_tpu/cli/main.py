@@ -1,0 +1,237 @@
+"""The opsagent CLI.
+
+Capability parity with the reference's cmd/kube-copilot/: root command with
+persistent flags --model/--max-tokens/--count-tokens/--verbose/
+--max-iterations (main.go:28-32) and subcommands server (server.go), execute
+(execute.go), analyze (analyze.go), audit (audit.go), diagnose (diagnose.go),
+generate (generate.go), version (version.go). Unlike the reference fork —
+which registers only ``server`` (main.go:34) and leaves the other commands as
+dead code — every subcommand here is wired up. A new ``serve-engine``
+subcommand starts the in-tree TPU serving engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .. import VERSION
+from ..utils.config import load_config
+from ..utils.globalstore import set_global
+from ..utils.logger import get_logger, init_logger
+from ..utils.perf import get_perf_stats
+
+
+def _add_common(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--model", default="gpt-4", help="model name or tpu://<model>")
+    parser.add_argument("--max-tokens", type=int, default=2048)
+    parser.add_argument("--count-tokens", action="store_true", default=False)
+    parser.add_argument("--verbose", action="store_true", default=False)
+    parser.add_argument("--max-iterations", type=int, default=10)
+    parser.add_argument("--api-key", default="", help="LLM API key (else env)")
+    parser.add_argument("--base-url", default="", help="LLM base URL (else env)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="opsagent",
+        description="Kubernetes AI agent with an in-tree TPU serving engine",
+    )
+    p.add_argument("--config", default="", help="path to config.yaml")
+    sub = p.add_subparsers(dest="command")
+
+    sp = sub.add_parser("server", help="run the REST API server")
+    sp.add_argument("--port", type=int, default=None, help="default: config server.port")
+    sp.add_argument("--host", default=None, help="default: config server.host")
+    sp.add_argument("--jwt-key", default="")
+    sp.add_argument("--show-thought", action="store_true", default=False)
+    _add_common(sp)
+
+    ex = sub.add_parser("execute", help="execute operations based on prompt instructions")
+    ex.add_argument("instructions", nargs="+")
+    _add_common(ex)
+
+    an = sub.add_parser("analyze", help="analyze issues for a given resource")
+    an.add_argument("--resource", default="pod")
+    an.add_argument("--name", required=True)
+    an.add_argument("--namespace", default="default")
+    _add_common(an)
+
+    au = sub.add_parser("audit", help="audit security issues for a pod")
+    au.add_argument("--name", required=True)
+    au.add_argument("--namespace", default="default")
+    _add_common(au)
+
+    di = sub.add_parser("diagnose", help="diagnose problems for a pod")
+    di.add_argument("--name", required=True)
+    di.add_argument("--namespace", default="default")
+    _add_common(di)
+
+    ge = sub.add_parser("generate", help="generate manifests and optionally apply")
+    ge.add_argument("prompt", nargs="+")
+    ge.add_argument("--yes", action="store_true", help="apply without confirmation")
+    _add_common(ge)
+
+    sub.add_parser("version", help="print version")
+
+    se = sub.add_parser("serve-engine", help="run the TPU serving engine (OpenAI-compatible)")
+    se.add_argument("--port", type=int, default=8000)
+    se.add_argument("--host", default="0.0.0.0")
+    se.add_argument("--model-name", default="tiny-test")
+    se.add_argument("--checkpoint", default="", help="safetensors checkpoint dir")
+    se.add_argument("--tokenizer", default="", help="HF tokenizer path (else byte tokenizer)")
+    se.add_argument("--tp", type=int, default=0, help="tensor-parallel size (0 = all devices)")
+    se.add_argument("--max-batch-size", type=int, default=8)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = load_config(args.config or None)
+    log_cfg = cfg.get("log", {})
+    init_logger(
+        level=log_cfg.get("level", "info"),
+        fmt=log_cfg.get("format", "json"),
+        output=log_cfg.get("output", "stdout"),
+        file_path=log_cfg.get("file", "logs/opsagent.log"),
+    )
+    log = get_logger("cli")
+
+    if args.command is None:
+        build_parser().print_help()
+        return 1
+
+    if args.command == "version":
+        print(f"opsagent {VERSION}")
+        return 0
+
+    if args.command == "server":
+        jwt_key = args.jwt_key or cfg.get("jwt", {}).get("key", "")
+        set_global("jwtKey", jwt_key)
+        set_global("showThought", args.show_thought)
+        from ..server.app import run_server
+
+        srv_cfg = cfg.get("server", {})
+        run_server(
+            host=args.host or srv_cfg.get("host", "0.0.0.0"),
+            port=args.port or srv_cfg.get("port", 8080),
+        )
+        return 0
+
+    if args.command == "serve-engine":
+        try:
+            from ..serving.api import run_engine_server
+        except ImportError as e:
+            print(f"serving engine unavailable: {e}", file=sys.stderr)
+            return 1
+
+        run_engine_server(
+            host=args.host,
+            port=args.port,
+            model_name=args.model_name,
+            checkpoint=args.checkpoint,
+            tokenizer=args.tokenizer,
+            tp=args.tp,
+            max_batch_size=args.max_batch_size,
+        )
+        return 0
+
+    from ..utils.term import render_markdown
+
+    if args.command == "execute":
+        from ..agent.prompts import REACT_SYSTEM_PROMPT, REFORMAT_PROMPT
+        from ..agent.react import assistant_with_config
+        from ..workflows import assistant_flow
+
+        instructions = " ".join(args.instructions)
+        messages = [
+            {"role": "system", "content": REACT_SYSTEM_PROMPT},
+            {"role": "user", "content": f"Here are the instructions: {instructions}"},
+        ]
+        response, _ = assistant_with_config(
+            args.model, messages, args.max_tokens, args.count_tokens,
+            args.verbose, args.max_iterations, args.api_key, args.base_url,
+        )
+        # Second LLM pass purely to reformat, as the reference does
+        # (execute.go:280-281).
+        try:
+            from ..llm.client import ChatClient
+
+            client = ChatClient(api_key=args.api_key, base_url=args.base_url)
+            result = assistant_flow(args.model, REFORMAT_PROMPT + response, client=client)
+        except Exception:  # noqa: BLE001 - reformat is best-effort
+            result = response
+        print(render_markdown(result))
+        if args.verbose:
+            print(get_perf_stats().format_table(), file=sys.stderr)
+        return 0
+
+    if args.command == "analyze":
+        from ..k8s import get_yaml
+        from ..workflows import analysis_flow
+
+        manifest = get_yaml(args.resource, args.name, args.namespace)
+        result = analysis_flow(args.model, manifest)
+        print(render_markdown(result))
+        return 0
+
+    if args.command == "audit":
+        from ..workflows import audit_flow
+
+        result = audit_flow(args.model, args.name, args.namespace)
+        print(render_markdown(result))
+        return 0
+
+    if args.command == "diagnose":
+        from ..agent.prompts import DIAGNOSE_SYSTEM_PROMPT
+        from ..agent.react import assistant_with_config
+
+        messages = [
+            {"role": "system", "content": DIAGNOSE_SYSTEM_PROMPT},
+            {
+                "role": "user",
+                "content": (
+                    f"Diagnose the Pod '{args.name}' in namespace "
+                    f"'{args.namespace}'."
+                ),
+            },
+        ]
+        response, _ = assistant_with_config(
+            args.model, messages, args.max_tokens, args.count_tokens,
+            args.verbose, args.max_iterations, args.api_key, args.base_url,
+        )
+        from ..utils.jsonrepair import extract_field
+
+        final = extract_field(response, "final_answer") or response
+        print(render_markdown(final))
+        return 0
+
+    if args.command == "generate":
+        from ..utils.yamlutil import extract_yaml
+        from ..workflows import generator_flow
+
+        prompt = " ".join(args.prompt)
+        result = generator_flow(args.model, prompt)
+        manifests = extract_yaml(result)
+        print(render_markdown(result))
+        if not args.yes:
+            try:
+                answer = input("Apply these manifests to the cluster? (y/N) ")
+            except EOFError:
+                answer = "n"
+            if answer.strip().lower() not in ("y", "yes"):
+                log.info("apply skipped")
+                return 0
+        from ..k8s import apply_yaml
+
+        applied = apply_yaml(manifests)
+        for item in applied:
+            print(f"applied: {item}")
+        return 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

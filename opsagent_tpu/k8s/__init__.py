@@ -1,0 +1,3 @@
+from .client import K8sClient, KubeConfig, get_kube_config, get_yaml, apply_yaml
+
+__all__ = ["K8sClient", "KubeConfig", "get_kube_config", "get_yaml", "apply_yaml"]

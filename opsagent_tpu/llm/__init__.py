@@ -1,0 +1,17 @@
+from .client import ChatClient, LLMError, register_provider
+from .tokens import (
+    get_token_limits,
+    num_tokens_from_messages,
+    constrict_messages,
+    constrict_prompt,
+)
+
+__all__ = [
+    "ChatClient",
+    "LLMError",
+    "register_provider",
+    "get_token_limits",
+    "num_tokens_from_messages",
+    "constrict_messages",
+    "constrict_prompt",
+]

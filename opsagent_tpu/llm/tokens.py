@@ -1,0 +1,127 @@
+"""Token accounting: context-window table, message counting, shrinking.
+
+Capability parity with the reference's pkg/llms/tokens.go: static
+context-window table including qwen-plus (tokens.go:26-46, default 4096 at
+tokens.go:55), OpenAI-cookbook message counting (tokens.go:60-107),
+``constrict_messages`` evicting the oldest non-system message until the
+conversation fits (tokens.go:110-125), and ``constrict_prompt`` dropping the
+leading third of lines until under the limit (tokens.go:128-144).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any
+
+# model-name prefix -> context window (tokens)
+TOKEN_LIMITS: dict[str, int] = {
+    "gpt-4o": 128000,
+    "gpt-4-turbo": 128000,
+    "gpt-4-32k": 32768,
+    "gpt-4": 8192,
+    "gpt-3.5-turbo-16k": 16384,
+    "gpt-3.5-turbo": 16384,
+    "qwen-plus": 131072,
+    "qwen-turbo": 131072,
+    "qwen2.5": 131072,
+    "deepseek": 65536,
+    "llama-3": 8192,
+    "llama3": 8192,
+    "tpu": 131072,
+}
+
+DEFAULT_TOKEN_LIMIT = 4096
+
+
+def get_token_limits(model: str) -> int:
+    m = model.lower()
+    if m.startswith("tpu://"):
+        m = "tpu"
+    best = 0
+    limit = DEFAULT_TOKEN_LIMIT
+    for prefix, window in TOKEN_LIMITS.items():
+        if m.startswith(prefix) and len(prefix) > best:
+            best = len(prefix)
+            limit = window
+    return limit
+
+
+@lru_cache(maxsize=4)
+def _encoding(name: str = "cl100k_base"):
+    try:
+        import tiktoken
+
+        return tiktoken.get_encoding(name)
+    except Exception:  # pragma: no cover - tiktoken is baked in
+        return None
+
+
+def count_tokens(text: str) -> int:
+    enc = _encoding()
+    if enc is None:
+        # ~4 chars/token heuristic fallback
+        return max(1, len(text) // 4)
+    return len(enc.encode(text, disallowed_special=()))
+
+
+def num_tokens_from_messages(messages: list[dict[str, Any]]) -> int:
+    """Count chat tokens per the OpenAI cookbook rules: 3 tokens of overhead
+    per message, +1 per name field, +3 for the assistant priming."""
+    total = 0
+    for msg in messages:
+        total += 3
+        for key, value in msg.items():
+            if isinstance(value, str):
+                total += count_tokens(value)
+            elif value is not None:
+                import json
+
+                total += count_tokens(json.dumps(value, ensure_ascii=False))
+            if key == "name":
+                total += 1
+    return total + 3
+
+
+def constrict_messages(
+    messages: list[dict[str, Any]], model: str, max_tokens: int
+) -> list[dict[str, Any]]:
+    """Evict the oldest non-system messages until the conversation plus the
+    reply budget fits the model's context window.
+
+    The system prompt(s) and the LAST message are never evicted: dropping the
+    newest turn would send the model a conversation with no question in it.
+    """
+    limit = get_token_limits(model) - max_tokens
+    msgs = list(messages)
+    while msgs and num_tokens_from_messages(msgs) > limit:
+        evicted = False
+        for i, m in enumerate(msgs[:-1]):
+            if m.get("role") != "system":
+                del msgs[i]
+                evicted = True
+                break
+        if not evicted:
+            break
+    return msgs
+
+
+def constrict_prompt(prompt: str, max_tokens: int) -> str:
+    """Shrink a prompt to fit ``max_tokens`` by repeatedly dropping the
+    leading third of its lines (keeping the tail, where the recent/salient
+    output is)."""
+    if max_tokens <= 0:
+        return ""
+    text = prompt
+    while text and count_tokens(text) > max_tokens:
+        lines = text.splitlines()
+        if len(lines) <= 1:
+            # Single huge line: cut by characters from the front.
+            keep = max(1, len(text) // 3 * 2)
+            text = text[-keep:]
+            if count_tokens(text) <= max_tokens:
+                return text
+            # Force convergence on pathological content.
+            approx = max_tokens * 4
+            return text[-approx:]
+        text = "\n".join(lines[len(lines) // 3 :])
+    return text

@@ -1,0 +1,140 @@
+"""HTTP router and middleware.
+
+Capability parity with the reference's pkg/api/router.go: recovery + logging
+middleware (router.go:29-30), permissive CORS including the X-API-Key header
+(router.go:33-42), request/response debug logging (router.go:45-75), global
+OPTIONS 204 (router.go:78-80), and the route table (router.go:82-106), plus
+the JWT middleware (pkg/middleware/jwt.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+from aiohttp import web
+
+from ..utils.globalstore import get_global
+from ..utils.logger import get_logger
+from ..utils.perf import get_perf_stats
+from . import handlers
+from .jwtauth import JWTError, decode
+
+log = get_logger("api")
+
+_CORS_HEADERS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "GET, POST, PUT, DELETE, OPTIONS",
+    "Access-Control-Allow-Headers": (
+        "Origin, Content-Type, Content-Length, Accept-Encoding, "
+        "Authorization, X-API-Key, X-Requested-With"
+    ),
+}
+
+Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
+
+PUBLIC_PATHS = {"/login", "/api/version", "/healthz"}
+
+
+@web.middleware
+async def cors_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    if request.method == "OPTIONS":
+        return web.Response(status=204, headers=_CORS_HEADERS)
+    resp = await handler(request)
+    resp.headers.update(_CORS_HEADERS)
+    return resp
+
+
+@web.middleware
+async def recovery_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except Exception:  # noqa: BLE001 - recovery boundary
+        log.exception("panic in handler %s %s", request.method, request.path)
+        return web.json_response({"error": "internal server error"}, status=500)
+
+
+@web.middleware
+async def logging_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    perf = get_perf_stats()
+    with perf.timer(f"http.{request.method}.{request.path}"):
+        resp = await handler(request)
+    log.info(
+        "%s %s -> %d",
+        request.method,
+        request.path,
+        getattr(resp, "status", 0),
+        extra={"fields": {"remote": request.remote}},
+    )
+    return resp
+
+
+@web.middleware
+async def jwt_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    if request.method == "OPTIONS" or request.path in PUBLIC_PATHS:
+        return await handler(request)
+    if request.path.startswith("/api/"):
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return web.json_response(
+                {"error": "missing or malformed Authorization header"}, status=401
+            )
+        key = get_global("jwtKey", "")
+        if not key:
+            # Never verify with an empty HMAC key — that would let anyone
+            # forge tokens signed with "".
+            return web.json_response(
+                {"error": "server JWT key not configured"}, status=500
+            )
+        try:
+            claims = decode(auth[len("Bearer ") :], key)
+        except JWTError as e:
+            return web.json_response({"error": f"invalid token: {e}"}, status=401)
+        request["username"] = claims.get("username", "")
+    return await handler(request)
+
+
+def build_app() -> web.Application:
+    app = web.Application(
+        middlewares=[
+            recovery_middleware,
+            cors_middleware,
+            logging_middleware,
+            jwt_middleware,
+        ],
+        client_max_size=16 * 1024 * 1024,
+    )
+    app.router.add_post("/login", handlers.login)
+    app.router.add_get("/api/version", handlers.version)
+    app.router.add_get("/healthz", handlers.version)
+    app.router.add_post("/api/execute", handlers.execute)
+    app.router.add_post("/api/diagnose", handlers.diagnose)
+    app.router.add_post("/api/analyze", handlers.analyze)
+    app.router.add_get("/api/perf/stats", handlers.perf_stats)
+    app.router.add_post("/api/perf/reset", handlers.perf_reset)
+    return app
+
+
+def run_server(host: str = "0.0.0.0", port: int = 8080) -> None:
+    app = build_app()
+
+    async def _announce(_: web.Application) -> None:
+        # Logged from on_startup so the line appears only once the socket is
+        # actually bound (readiness signal for scripts tailing the log).
+        log.info("opsagent server listening on %s:%d", host, port)
+
+    app.on_startup.append(_announce)
+    web.run_app(app, host=host, port=port, print=None)
+
+
+async def start_background(host: str, port: int) -> web.AppRunner:
+    """Start the server inside an existing event loop (used by tests and by
+    co-hosting with the serving engine)."""
+    app = build_app()
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

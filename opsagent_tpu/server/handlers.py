@@ -1,0 +1,286 @@
+"""HTTP handlers.
+
+Capability parity with the reference's pkg/handlers/: login (auth.go:25-72),
+execute with its 4-stage tolerant response parse and tools_history
+reconstruction (execute.go:106-444), perf stats/reset (perf.go:12-39), and
+version (version.go:8-13). The reference's analyze/diagnose handlers are
+placeholder stubs (analyze.go:25-27, diagnose.go:26-28); here they are
+implemented for real over the workflow/agent layer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+from aiohttp import web
+
+from .. import VERSION
+from ..agent.prompts import DIAGNOSE_SYSTEM_PROMPT, EXECUTE_SYSTEM_PROMPT_CN
+from ..agent.react import assistant_with_config
+from ..llm.client import ChatClient, LLMError
+from ..tools import ToolPrompt
+from ..utils.globalstore import get_global
+from ..utils.jsonrepair import extract_field, parse_json
+from ..utils.logger import get_logger
+from ..utils.perf import get_perf_stats, trace_func
+from .jwtauth import issue_token
+
+log = get_logger("server")
+
+DEFAULT_USERNAME = "admin"
+DEFAULT_PASSWORD = "novastar"
+DEFAULT_MODEL = "gpt-4"
+SERVER_MAX_TOKENS = 8192
+SERVER_MAX_ITERATIONS = 5
+
+
+# -- auth -------------------------------------------------------------------
+async def login(request: web.Request) -> web.Response:
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    username = body.get("username", "")
+    password = body.get("password", "")
+    expected_user = get_global("username", DEFAULT_USERNAME)
+    expected_pass = get_global("password", DEFAULT_PASSWORD)
+    if username != expected_user or password != expected_pass:
+        return web.json_response({"error": "invalid credentials"}, status=401)
+    key = get_global("jwtKey", "")
+    if not key:
+        return web.json_response({"error": "server JWT key not configured"}, status=500)
+    token = issue_token(username, key)
+    return web.json_response({"token": token, "expires_in": 24 * 3600})
+
+
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": VERSION})
+
+
+# -- execute ----------------------------------------------------------------
+def _tools_history(chat_history: list[dict[str, Any]]) -> list[dict[str, str]]:
+    """Reconstruct the tool-call history by re-parsing the user messages the
+    ReAct loop marshaled (reference execute.go:224-244)."""
+    out: list[dict[str, str]] = []
+    for msg in chat_history:
+        if msg.get("role") != "user":
+            continue
+        try:
+            tp = ToolPrompt.from_json(msg.get("content") or "")
+        except (ValueError, TypeError):
+            continue
+        if tp.action.name:
+            out.append(
+                {
+                    "name": tp.action.name,
+                    "input": tp.action.input,
+                    "observation": tp.observation,
+                }
+            )
+    return out
+
+
+def _parse_agent_response(
+    response: str,
+    tools_history: list[dict[str, str]],
+    show_thought: bool,
+) -> dict[str, Any]:
+    """4-stage tolerant parse of the agent's final response (reference
+    execute.go:250-404): strict JSON -> regex field extraction -> cleaned JSON
+    -> generic map -> raw passthrough."""
+
+    def ok(
+        message: str,
+        thought: str = "",
+        question: str = "",
+        action: Any = None,
+        observation: str = "",
+        raw: bool = False,
+    ) -> dict[str, Any]:
+        data: dict[str, Any] = {"message": message, "status": "success"}
+        if raw:
+            data["raw_response"] = True
+        if show_thought:
+            data["thought"] = thought
+            data["question"] = question
+            data["action"] = action if action is not None else {}
+            data["observation"] = observation
+            data["tools_history"] = tools_history
+        return data
+
+    # Stage 1: strict JSON with a final_answer.
+    try:
+        obj = json.loads(response)
+        if isinstance(obj, dict) and obj.get("final_answer"):
+            tp = ToolPrompt.from_dict(obj)
+            return ok(
+                tp.final_answer, tp.thought, tp.question,
+                {"name": tp.action.name, "input": tp.action.input}, tp.observation,
+            )
+    except (json.JSONDecodeError, ValueError):
+        pass
+
+    # Stage 2: regex field extraction from JSON-ish text.
+    final = extract_field(response, "final_answer")
+    if final:
+        return ok(
+            final,
+            extract_field(response, "thought"),
+            extract_field(response, "question"),
+            extract_field(response, "action"),
+            extract_field(response, "observation"),
+        )
+
+    # Stage 3: repair then parse (parse_json retries with clean_json itself).
+    try:
+        obj = parse_json(response)
+        if isinstance(obj, dict):
+            if obj.get("final_answer"):
+                tp = ToolPrompt.from_dict(obj)
+                return ok(
+                    tp.final_answer, tp.thought, tp.question,
+                    {"name": tp.action.name, "input": tp.action.input}, tp.observation,
+                )
+            # Stage 4: generic map — surface whatever fields exist.
+            msg = obj.get("message") or obj.get("answer") or obj.get("content")
+            if isinstance(msg, str) and msg:
+                return ok(msg, str(obj.get("thought") or ""))
+    except ValueError:
+        pass
+
+    # Stage 5: raw passthrough.
+    return ok(response, raw=True)
+
+
+async def execute(request: web.Request) -> web.Response:
+    perf = get_perf_stats()
+    stop = trace_func("execute_total")
+    try:
+        api_key = request.headers.get("X-API-Key", "")
+        if not api_key and not get_global("allowAnonymousLLM", False):
+            return web.json_response({"error": "Missing API Key"}, status=400)
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        instructions = body.get("instructions", "")
+        if not instructions:
+            return web.json_response({"error": "instructions is required"}, status=400)
+
+        show_thought_q = request.query.get("show-thought", "")
+        if show_thought_q != "":
+            show_thought = show_thought_q == "true"
+        else:
+            show_thought = bool(get_global("showThought", False))
+
+        model = body.get("currentModel") or DEFAULT_MODEL
+        base_url = body.get("baseUrl") or ""
+        messages = [
+            {"role": "system", "content": EXECUTE_SYSTEM_PROMPT_CN},
+            {"role": "user", "content": instructions},
+        ]
+        try:
+            response, history = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: assistant_with_config(
+                    model,
+                    messages,
+                    SERVER_MAX_TOKENS,
+                    True,
+                    True,
+                    SERVER_MAX_ITERATIONS,
+                    api_key,
+                    base_url,
+                ),
+            )
+        except LLMError as e:
+            return web.json_response(
+                {"error": f"agent failed: {e}", "status": "error"}, status=500
+            )
+        tools_history = _tools_history(history)
+        with perf.timer("execute_response_parse"):
+            data = _parse_agent_response(response, tools_history, show_thought)
+        return web.json_response(data)
+    finally:
+        stop()
+
+
+# -- analyze / diagnose -----------------------------------------------------
+async def analyze(request: web.Request) -> web.Response:
+    """Fetch the live object and run the analysis workflow (the reference's
+    handler is a TODO stub, pkg/handlers/analyze.go:25-27 — implemented here)."""
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    resource = body.get("resource", "pod")
+    name = body.get("name", "")
+    namespace = body.get("namespace", "default")
+    if not name:
+        return web.json_response({"error": "name is required"}, status=400)
+    model = body.get("currentModel") or DEFAULT_MODEL
+    api_key = request.headers.get("X-API-Key", "")
+    base_url = body.get("baseUrl") or ""
+
+    def run() -> str:
+        from ..k8s import get_yaml
+        from ..workflows import analysis_flow
+
+        manifest = get_yaml(resource, name, namespace)
+        client = ChatClient(api_key=api_key, base_url=base_url)
+        return analysis_flow(model, manifest, client=client)
+
+    try:
+        result = await asyncio.get_running_loop().run_in_executor(None, run)
+    except Exception as e:  # noqa: BLE001 - surfaced as HTTP error
+        return web.json_response({"error": str(e), "status": "error"}, status=500)
+    return web.json_response({"message": result, "status": "success"})
+
+
+async def diagnose(request: web.Request) -> web.Response:
+    """Diagnose a pod with the ReAct loop (the reference's handler is a TODO
+    stub, pkg/handlers/diagnose.go:26-28 — implemented here)."""
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    pod = body.get("pod") or body.get("name") or ""
+    namespace = body.get("namespace", "default")
+    if not pod:
+        return web.json_response({"error": "pod is required"}, status=400)
+    model = body.get("currentModel") or DEFAULT_MODEL
+    api_key = request.headers.get("X-API-Key", "")
+    base_url = body.get("baseUrl") or ""
+    messages = [
+        {"role": "system", "content": DIAGNOSE_SYSTEM_PROMPT},
+        {
+            "role": "user",
+            "content": f"Diagnose the Pod '{pod}' in namespace '{namespace}'.",
+        },
+    ]
+    try:
+        response, history = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: assistant_with_config(
+                model, messages, SERVER_MAX_TOKENS, True, True,
+                SERVER_MAX_ITERATIONS, api_key, base_url,
+            ),
+        )
+    except LLMError as e:
+        return web.json_response(
+            {"error": f"diagnose failed: {e}", "status": "error"}, status=500
+        )
+    data = _parse_agent_response(response, _tools_history(history), False)
+    return web.json_response(data)
+
+
+# -- perf -------------------------------------------------------------------
+async def perf_stats(request: web.Request) -> web.Response:
+    return web.json_response({"stats": get_perf_stats().get_stats()})
+
+
+async def perf_reset(request: web.Request) -> web.Response:
+    get_perf_stats().reset()
+    return web.json_response({"status": "reset"})

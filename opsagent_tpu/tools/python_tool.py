@@ -1,0 +1,40 @@
+"""python tool: execute a Python script and return stdout.
+
+Capability parity with the reference's pkg/tools/python.go:30-32 which runs
+``python3 -c "<script>"`` inside a dedicated k8s ops venv. We execute the
+script with the venv's interpreter when the conventional layout exists
+(~/k8s/python-cli/k8s-env, matching the reference Dockerfile:34-44), else the
+current interpreter; the script is passed via argv so no shell quoting/escaping
+of the script body is needed.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from . import ToolError
+
+_VENV_PY = os.path.expanduser("~/k8s/python-cli/k8s-env/bin/python3")
+
+
+def interpreter() -> str:
+    return _VENV_PY if os.path.isfile(_VENV_PY) else sys.executable
+
+
+def python_repl(script: str, timeout: float = 120.0) -> str:
+    try:
+        proc = subprocess.run(
+            [interpreter(), "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.expanduser("~"),
+        )
+    except subprocess.TimeoutExpired as e:
+        raise ToolError(f"python script timed out after {timeout}s") from e
+    if proc.returncode != 0:
+        raise ToolError(proc.stderr.strip() or f"python exited with {proc.returncode}")
+    out = proc.stdout.strip()
+    return out if out else "(no output)"

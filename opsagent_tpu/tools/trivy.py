@@ -1,0 +1,34 @@
+"""trivy tool: container image vulnerability scanning.
+
+Capability parity with the reference's pkg/tools/trivy.go: strips an optional
+``image `` prefix (trivy.go:29-31) and runs ``trivy image <img> --scanners
+vuln`` (trivy.go:37).
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+from . import ToolError
+
+
+def trivy(image: str, timeout: float = 300.0) -> str:
+    img = image.strip()
+    if img.startswith("image "):
+        img = img[len("image ") :].strip()
+    if not img:
+        raise ToolError("no image name given to trivy")
+    try:
+        proc = subprocess.run(
+            ["trivy", "image", img, "--scanners", "vuln"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except FileNotFoundError as e:
+        raise ToolError(f"trivy not available: {e}") from e
+    except subprocess.TimeoutExpired as e:
+        raise ToolError(f"trivy timed out after {timeout}s") from e
+    if proc.returncode != 0:
+        raise ToolError(proc.stderr.strip() or f"trivy exited with {proc.returncode}")
+    return proc.stdout.strip() or "(no output)"

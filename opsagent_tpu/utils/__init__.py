@@ -1,0 +1,23 @@
+from .jsonrepair import clean_json, parse_json, extract_field
+from .globalstore import set_global, get_global, delete_global
+from .yamlutil import extract_yaml
+from .perf import PerfStats, get_perf_stats, trace_func
+from .config import load_config, get_config
+from .logger import get_logger, init_logger
+
+__all__ = [
+    "clean_json",
+    "parse_json",
+    "extract_field",
+    "set_global",
+    "get_global",
+    "delete_global",
+    "extract_yaml",
+    "PerfStats",
+    "get_perf_stats",
+    "trace_func",
+    "load_config",
+    "get_config",
+    "get_logger",
+    "init_logger",
+]

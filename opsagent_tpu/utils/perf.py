@@ -1,0 +1,159 @@
+"""Performance statistics registry.
+
+Named timers and metrics with min/max/avg/p95/p99 aggregation, exposed over
+``GET /api/perf/stats`` and printed by verbose CLI runs.
+
+Capability parity with the reference's pkg/utils/perf.go (singleton perf.go:33,
+timers perf.go:64-139, aggregation perf.go:168-210, HTTP accessors
+perf.go:296-335). On the TPU side this registry also carries the serving
+engine's first-class gauges (tokens/sec/chip, TTFT; SURVEY.md section 5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator
+
+
+class _Series:
+    __slots__ = ("values", "unit")
+
+    def __init__(self, unit: str = "ms") -> None:
+        self.values: list[float] = []
+        self.unit = unit
+
+    def summary(self) -> dict[str, Any]:
+        vs = sorted(self.values)
+        n = len(vs)
+        if n == 0:
+            return {"count": 0, "unit": self.unit}
+
+        def pct(p: float) -> float:
+            idx = min(n - 1, max(0, int(round(p * (n - 1)))))
+            return vs[idx]
+
+        return {
+            "count": n,
+            "unit": self.unit,
+            "min": vs[0],
+            "max": vs[-1],
+            "avg": sum(vs) / n,
+            "p50": pct(0.50),
+            "p95": pct(0.95),
+            "p99": pct(0.99),
+        }
+
+
+class PerfStats:
+    """Thread-safe registry of named timers, metrics and gauges."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._series: dict[str, _Series] = {}
+        self._gauges: dict[str, float] = {}
+        # name -> stack of start times: overlapping same-name timers from
+        # concurrent requests pair LIFO instead of clobbering a single slot.
+        self._active: dict[str, list[float]] = {}
+        self.enabled = True
+
+    # -- timers ------------------------------------------------------------
+    def start_timer(self, name: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._active.setdefault(name, []).append(time.perf_counter())
+
+    def stop_timer(self, name: str) -> float:
+        if not self.enabled:
+            return 0.0
+        now = time.perf_counter()
+        with self._lock:
+            stack = self._active.get(name)
+            if not stack:
+                return 0.0
+            t0 = stack.pop()
+            ms = (now - t0) * 1e3
+            self._series.setdefault(name, _Series("ms")).values.append(ms)
+            return ms
+
+    @contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record_metric(name, (time.perf_counter() - t0) * 1e3, "ms")
+
+    # -- metrics / gauges --------------------------------------------------
+    def record_metric(self, name: str, value: float, unit: str = "") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._series.setdefault(name, _Series(unit)).values.append(value)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    # -- accessors ---------------------------------------------------------
+    def get_stats(self) -> dict[str, Any]:
+        with self._lock:
+            out: dict[str, Any] = {
+                name: s.summary() for name, s in self._series.items()
+            }
+            if self._gauges:
+                out["gauges"] = dict(self._gauges)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._gauges.clear()
+            self._active.clear()
+
+    def format_table(self) -> str:
+        stats = self.get_stats()
+        gauges = stats.pop("gauges", {})
+        lines = [
+            f"{'operation':<44} {'count':>6} {'avg':>9} {'p95':>9} {'p99':>9} {'max':>9} unit"
+        ]
+        for name in sorted(stats):
+            s = stats[name]
+            if s.get("count", 0) == 0:
+                continue
+            lines.append(
+                f"{name:<44} {s['count']:>6} {s['avg']:>9.2f} {s['p95']:>9.2f} "
+                f"{s['p99']:>9.2f} {s['max']:>9.2f} {s['unit']}"
+            )
+        for name in sorted(gauges):
+            lines.append(f"{name:<44} {'gauge':>6} {gauges[name]:>9.2f}")
+        return "\n".join(lines)
+
+
+_singleton: PerfStats | None = None
+_singleton_lock = threading.Lock()
+
+
+def get_perf_stats() -> PerfStats:
+    global _singleton
+    if _singleton is None:
+        with _singleton_lock:
+            if _singleton is None:
+                _singleton = PerfStats()
+    return _singleton
+
+
+def trace_func(name: str) -> Callable[[], None]:
+    """Start a timer and return the stopper; mirrors ``defer TraceFunc()()``
+    instrumentation style (reference pkg/utils/perf.go:288-293). The start
+    time lives in the closure, so concurrent traces of the same name are
+    each timed correctly."""
+    ps = get_perf_stats()
+    t0 = time.perf_counter()
+
+    def stop() -> None:
+        ps.record_metric(name, (time.perf_counter() - t0) * 1e3, "ms")
+
+    return stop

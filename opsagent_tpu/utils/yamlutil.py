@@ -1,0 +1,20 @@
+"""YAML extraction from LLM output.
+
+Capability parity with the reference's pkg/utils/yaml.go:22-36 (fenced
+```yaml``` code-block extraction used by the generate workflow).
+"""
+
+from __future__ import annotations
+
+import re
+
+_FENCE = re.compile(r"```(?:yaml|yml)?\s*\n(.*?)```", re.DOTALL)
+
+
+def extract_yaml(s: str) -> str:
+    """Return the contents of the first fenced YAML block, or the input
+    unchanged when no fence is present."""
+    m = _FENCE.search(s)
+    if m:
+        return m.group(1).strip() + "\n"
+    return s

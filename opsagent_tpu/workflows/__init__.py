@@ -1,0 +1,25 @@
+"""Workflow layer: task-shaped flows over the function-calling agent.
+
+Capability parity with the reference's pkg/workflows/: ``analysis_flow``
+(analyze.go:47), ``audit_flow`` (audit.go:58), ``generator_flow``
+(generate.go:56), ``assistant_flow`` / ``assistant_flow_with_config``
+(assistant.go:69,163). The reference's ``AssistantFlow`` accidentally passes
+the analysis prompt instead of its own (assistant.go:96); this rebuild uses
+the correct assistant prompt.
+"""
+
+from .flows import (
+    analysis_flow,
+    audit_flow,
+    generator_flow,
+    assistant_flow,
+    assistant_flow_with_config,
+)
+
+__all__ = [
+    "analysis_flow",
+    "audit_flow",
+    "generator_flow",
+    "assistant_flow",
+    "assistant_flow_with_config",
+]

@@ -1,0 +1,88 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh: the env vars must be set before
+jax initializes its backends (the tpu-native answer to testing multi-chip
+sharding without a real pod slice; SURVEY.md section 4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from opsagent_tpu.llm import client as llm_client  # noqa: E402
+from opsagent_tpu import tools as tools_pkg  # noqa: E402
+from opsagent_tpu.utils.globalstore import clear_globals  # noqa: E402
+from opsagent_tpu.utils.perf import get_perf_stats  # noqa: E402
+
+
+class ScriptedLLM:
+    """A scripted fake chat provider: pops one canned reply per request.
+
+    Replies may be strings (assistant content), dicts (full assistant
+    messages, e.g. with tool_calls), or callables taking the request body.
+    """
+
+    def __init__(self, replies):
+        self.replies = list(replies)
+        self.requests = []
+
+    def __call__(self, body):
+        import copy
+
+        self.requests.append(copy.deepcopy(body))
+        if not self.replies:
+            raise AssertionError("ScriptedLLM ran out of replies")
+        r = self.replies.pop(0)
+        if callable(r):
+            r = r(body)
+        message = r if isinstance(r, dict) else {"role": "assistant", "content": r}
+        return {
+            "id": "fake",
+            "object": "chat.completion",
+            "choices": [{"index": 0, "message": message, "finish_reason": "stop"}],
+            "usage": {},
+        }
+
+
+@pytest.fixture
+def scripted_llm():
+    """Register a ScriptedLLM under the fake:// scheme; use model='fake://m'."""
+
+    def _register(replies):
+        fake = ScriptedLLM(replies)
+        llm_client.register_provider("fake", lambda target: fake)
+        return fake
+
+    yield _register
+    llm_client._provider_factories.pop("fake", None)
+
+
+@pytest.fixture
+def fake_tools():
+    """Replace the tool registry with test doubles; restore afterwards."""
+    saved = dict(tools_pkg.copilot_tools)
+
+    def _install(mapping):
+        tools_pkg.copilot_tools.clear()
+        tools_pkg.copilot_tools.update(mapping)
+        return mapping
+
+    yield _install
+    tools_pkg.copilot_tools.clear()
+    tools_pkg.copilot_tools.update(saved)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    clear_globals()
+    get_perf_stats().reset()
+    yield
+    clear_globals()
+    get_perf_stats().reset()

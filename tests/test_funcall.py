@@ -1,0 +1,113 @@
+"""Tests for the native function-calling agent loop and workflows."""
+
+import json
+
+from opsagent_tpu.agent.funcall import AgentFunction, run_function_agent
+from opsagent_tpu.llm.client import ChatClient
+from opsagent_tpu.workflows import analysis_flow, generator_flow
+
+
+def echo_function(log):
+    return AgentFunction(
+        name="kubectl",
+        description="run kubectl",
+        parameters={
+            "type": "object",
+            "properties": {"command": {"type": "string"}},
+            "required": ["command"],
+        },
+        fn=lambda command: (log.append(command), f"ran: {command}")[1],
+    )
+
+
+def tool_call_msg(name, args, call_id="call_1"):
+    return {
+        "role": "assistant",
+        "content": None,
+        "tool_calls": [
+            {
+                "id": call_id,
+                "type": "function",
+                "function": {"name": name, "arguments": json.dumps(args)},
+            }
+        ],
+    }
+
+
+def test_function_agent_roundtrip(scripted_llm):
+    log = []
+    fake = scripted_llm(
+        [
+            tool_call_msg("kubectl", {"command": "get pods"}),
+            {"role": "assistant", "content": "2 pods are running."},
+        ]
+    )
+    client = ChatClient(api_key="k", base_url="")
+    out, history = run_function_agent(
+        client, "fake://m", "instructions", "how many pods?", [echo_function(log)]
+    )
+    assert out == "2 pods are running."
+    assert log == ["get pods"]
+    tool_msg = fake.requests[1]["messages"][-1]
+    assert tool_msg["role"] == "tool"
+    assert tool_msg["content"] == "ran: get pods"
+    assert tool_msg["tool_call_id"] == "call_1"
+    # tool schemas were offered
+    assert fake.requests[0]["tools"][0]["function"]["name"] == "kubectl"
+
+
+def test_function_agent_unknown_function(scripted_llm):
+    fake = scripted_llm(
+        [
+            tool_call_msg("helm", {}),
+            {"role": "assistant", "content": "ok"},
+        ]
+    )
+    client = ChatClient(api_key="k")
+    out, _ = run_function_agent(client, "fake://m", "i", "u", [])
+    assert out == "ok"
+    assert "not available" in fake.requests[1]["messages"][-1]["content"]
+
+
+def test_function_agent_bad_arguments(scripted_llm):
+    log = []
+    fake = scripted_llm(
+        [
+            {
+                "role": "assistant",
+                "content": None,
+                "tool_calls": [
+                    {
+                        "id": "c",
+                        "type": "function",
+                        "function": {"name": "kubectl", "arguments": "{broken"},
+                    }
+                ],
+            },
+            {"role": "assistant", "content": "done"},
+        ]
+    )
+    client = ChatClient(api_key="k")
+    out, _ = run_function_agent(client, "fake://m", "i", "u", [echo_function(log)])
+    assert out == "done"
+    assert "invalid function arguments" in fake.requests[1]["messages"][-1]["content"]
+    assert log == []
+
+
+def test_analysis_flow(scripted_llm):
+    fake = scripted_llm([{"role": "assistant", "content": "Looks fine."}])
+    client = ChatClient(api_key="k")
+    out = analysis_flow("fake://m", "kind: Pod\nmetadata:\n  name: x", client=client)
+    assert out == "Looks fine."
+    sent = fake.requests[0]["messages"][1]["content"]
+    assert "kind: Pod" in sent
+
+
+def test_generator_flow_no_tools(scripted_llm):
+    fake = scripted_llm(
+        [{"role": "assistant", "content": "```yaml\nkind: Deployment\n```"}]
+    )
+    client = ChatClient(api_key="k")
+    out = generator_flow("fake://m", "an nginx deployment", client=client)
+    assert "Deployment" in out
+    assert "tools" not in fake.requests[0]

@@ -1,0 +1,68 @@
+"""Tests for the JSON repair ladder (reference left pkg/utils/json.go untested)."""
+
+import pytest
+
+from opsagent_tpu.utils.jsonrepair import clean_json, extract_field, parse_json
+
+
+def test_parse_strict():
+    assert parse_json('{"a": 1}') == {"a": 1}
+
+
+def test_parse_with_code_fence():
+    s = 'Here you go:\n```json\n{"thought": "x", "final_answer": "done"}\n```\nEnjoy.'
+    assert parse_json(s)["final_answer"] == "done"
+
+
+def test_parse_with_surrounding_prose():
+    s = 'Sure! {"a": "b"} hope that helps'
+    assert parse_json(s) == {"a": "b"}
+
+
+def test_raw_newlines_inside_strings():
+    s = '{"final_answer": "line one\nline two"}'
+    assert parse_json(s)["final_answer"] == "line one\nline two"
+
+
+def test_trailing_commas():
+    assert parse_json('{"a": [1, 2,], "b": 2,}') == {"a": [1, 2], "b": 2}
+
+
+def test_unterminated_object_closed():
+    s = '{"question": "q", "thought": "started but never finis'
+    obj = parse_json(s)
+    assert obj["question"] == "q"
+
+
+def test_nested_braces_in_strings():
+    s = 'prefix {"cmd": "kubectl get pods -o jsonpath={.items[0]}", "n": 1} suffix'
+    assert parse_json(s)["n"] == 1
+
+
+def test_unparseable_raises():
+    with pytest.raises(ValueError):
+        parse_json("no json here at all")
+
+
+def test_extract_field_strict():
+    assert extract_field('{"final_answer": "yes"}', "final_answer") == "yes"
+
+
+def test_extract_field_regex_fallback():
+    s = 'garbage "final_answer": "it has \\"quotes\\" inside" garbage'
+    assert extract_field(s, "final_answer") == 'it has "quotes" inside'
+
+
+def test_extract_field_missing():
+    assert extract_field('{"a": 1}', "missing") == ""
+
+
+def test_extract_field_object_value():
+    s = '{"action": {"name": "kubectl", "input": "get ns"}}'
+    out = extract_field(s, "action")
+    assert "kubectl" in out
+
+
+def test_clean_json_idempotent_on_valid():
+    s = '{"a": "b"}'
+    assert clean_json(s) == s

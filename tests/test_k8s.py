@@ -1,0 +1,207 @@
+"""Kubernetes client tests against a fake apiserver (the reference shipped
+its client-go layer untested; SURVEY.md section 4)."""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+import yaml
+
+from opsagent_tpu.k8s.client import K8sClient, KubeConfig, K8sError, _load_kubeconfig_file
+
+POD = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {"name": "mypod", "namespace": "default"},
+    "spec": {"containers": [{"name": "c", "image": "nginx:1.25"}]},
+}
+
+
+class FakeAPIServer(BaseHTTPRequestHandler):
+    applied = []
+
+    def _json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path == "/api/v1":
+            self._json(
+                {
+                    "resources": [
+                        {
+                            "name": "pods",
+                            "singularName": "pod",
+                            "kind": "Pod",
+                            "namespaced": True,
+                            "shortNames": ["po"],
+                        },
+                        {
+                            "name": "pods/log",
+                            "singularName": "",
+                            "kind": "Pod",
+                            "namespaced": True,
+                        },
+                        {
+                            "name": "namespaces",
+                            "singularName": "namespace",
+                            "kind": "Namespace",
+                            "namespaced": False,
+                            "shortNames": ["ns"],
+                        },
+                    ]
+                }
+            )
+        elif self.path == "/apis":
+            self._json(
+                {
+                    "groups": [
+                        {
+                            "name": "apps",
+                            "preferredVersion": {"groupVersion": "apps/v1"},
+                        }
+                    ]
+                }
+            )
+        elif self.path == "/apis/apps/v1":
+            self._json(
+                {
+                    "resources": [
+                        {
+                            "name": "deployments",
+                            "singularName": "deployment",
+                            "kind": "Deployment",
+                            "namespaced": True,
+                            "shortNames": ["deploy"],
+                        }
+                    ]
+                }
+            )
+        elif self.path == "/api/v1/namespaces/default/pods/mypod":
+            self._json(POD)
+        else:
+            self._json({"kind": "Status", "message": "not found"}, status=404)
+
+    def do_PATCH(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        FakeAPIServer.applied.append(
+            {
+                "path": self.path,
+                "content_type": self.headers.get("Content-Type"),
+                "body": body.decode(),
+                "auth": self.headers.get("Authorization", ""),
+            }
+        )
+        self._json({"status": "ok"})
+
+
+@pytest.fixture
+def fake_apiserver():
+    FakeAPIServer.applied = []
+    server = HTTPServer(("127.0.0.1", 0), FakeAPIServer)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def test_get_yaml(fake_apiserver):
+    client = K8sClient(KubeConfig(server=fake_apiserver, token="tok"))
+    out = client.get_yaml("pod", "mypod", "default")
+    obj = yaml.safe_load(out)
+    assert obj["metadata"]["name"] == "mypod"
+    assert obj["spec"]["containers"][0]["image"] == "nginx:1.25"
+
+
+def test_get_yaml_by_shortname_and_plural(fake_apiserver):
+    client = K8sClient(KubeConfig(server=fake_apiserver))
+    assert "mypod" in client.get_yaml("po", "mypod", "default")
+    assert "mypod" in client.get_yaml("pods", "mypod", "default")
+
+
+def test_unknown_resource(fake_apiserver):
+    client = K8sClient(KubeConfig(server=fake_apiserver))
+    with pytest.raises(K8sError):
+        client.get_yaml("frob", "x", "default")
+
+
+def test_apply_yaml_server_side(fake_apiserver):
+    client = K8sClient(KubeConfig(server=fake_apiserver, token="tok"))
+    manifests = """
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+  namespace: default
+spec:
+  replicas: 2
+---
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: staging
+"""
+    applied = client.apply_yaml(manifests)
+    assert applied == ["Deployment/web", "Namespace/staging"]
+    first = FakeAPIServer.applied[0]
+    assert first["path"].startswith("/apis/apps/v1/namespaces/default/deployments/web")
+    assert "fieldManager=application%2Fapply-patch" in first["path"]
+    assert "force=true" in first["path"]
+    assert first["content_type"] == "application/apply-patch+yaml"
+    assert first["auth"] == "Bearer tok"
+    second = FakeAPIServer.applied[1]
+    assert second["path"].startswith("/api/v1/namespaces/staging")
+
+
+def test_apply_yaml_missing_fields(fake_apiserver):
+    client = K8sClient(KubeConfig(server=fake_apiserver))
+    with pytest.raises(K8sError):
+        client.apply_yaml("kind: Pod\nmetadata: {}\n")
+
+
+def test_kubeconfig_file_parse(tmp_path):
+    ca = base64.b64encode(b"fake-ca").decode()
+    cfg_file = tmp_path / "config"
+    cfg_file.write_text(
+        yaml.safe_dump(
+            {
+                "current-context": "ctx",
+                "contexts": [
+                    {
+                        "name": "ctx",
+                        "context": {
+                            "cluster": "c1",
+                            "user": "u1",
+                            "namespace": "ops",
+                        },
+                    }
+                ],
+                "clusters": [
+                    {
+                        "name": "c1",
+                        "cluster": {
+                            "server": "https://k8s.example:6443",
+                            "certificate-authority-data": ca,
+                        },
+                    }
+                ],
+                "users": [{"name": "u1", "user": {"token": "secret"}}],
+            }
+        )
+    )
+    cfg = _load_kubeconfig_file(str(cfg_file))
+    assert cfg.server == "https://k8s.example:6443"
+    assert cfg.token == "secret"
+    assert cfg.namespace == "ops"
+    with open(cfg.ca_cert_path, "rb") as f:
+        assert f.read() == b"fake-ca"

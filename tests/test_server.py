@@ -1,0 +1,216 @@
+"""REST API tests: auth, JWT guard, execute path with a scripted engine."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from opsagent_tpu.server.app import build_app
+from opsagent_tpu.server.jwtauth import decode, encode, issue_token, JWTError
+from opsagent_tpu.utils.globalstore import set_global
+
+JWT_KEY = "test-key"
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+async def _client():
+    app = build_app()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def test_jwt_roundtrip():
+    token = issue_token("admin", JWT_KEY)
+    claims = decode(token, JWT_KEY)
+    assert claims["username"] == "admin"
+    assert claims["exp"] > claims["iat"]
+
+
+def test_jwt_bad_signature():
+    token = issue_token("admin", JWT_KEY)
+    try:
+        decode(token, "other-key")
+        raise AssertionError("expected JWTError")
+    except JWTError:
+        pass
+
+
+def test_jwt_expired():
+    token = encode({"username": "x", "exp": 1}, JWT_KEY)
+    try:
+        decode(token, JWT_KEY)
+        raise AssertionError("expected JWTError")
+    except JWTError:
+        pass
+
+
+def test_login_and_version():
+    set_global("jwtKey", JWT_KEY)
+
+    async def scenario():
+        client = await _client()
+        try:
+            r = await client.post(
+                "/login", json={"username": "admin", "password": "novastar"}
+            )
+            assert r.status == 200
+            token = (await r.json())["token"]
+            assert decode(token, JWT_KEY)["username"] == "admin"
+
+            r = await client.post(
+                "/login", json={"username": "admin", "password": "wrong"}
+            )
+            assert r.status == 401
+
+            r = await client.get("/api/version")
+            assert r.status == 200
+            assert "version" in await r.json()
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_empty_jwt_key_rejects_all_tokens():
+    # With no jwtKey configured the middleware must refuse, not verify
+    # against an empty HMAC key (which would let anyone forge tokens).
+    forged = issue_token("admin", "")
+
+    async def scenario():
+        client = await _client()
+        try:
+            r = await client.post(
+                "/api/execute",
+                json={"instructions": "x"},
+                headers={"Authorization": f"Bearer {forged}"},
+            )
+            assert r.status == 500
+            assert "not configured" in (await r.json())["error"]
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_protected_route_requires_jwt():
+    set_global("jwtKey", JWT_KEY)
+
+    async def scenario():
+        client = await _client()
+        try:
+            r = await client.post("/api/execute", json={"instructions": "x"})
+            assert r.status == 401
+            r = await client.get("/api/perf/stats")
+            assert r.status == 401
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_cors_preflight():
+    async def scenario():
+        client = await _client()
+        try:
+            r = await client.options("/api/execute")
+            assert r.status == 204
+            assert "X-API-Key" in r.headers["Access-Control-Allow-Headers"]
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_execute_end_to_end(scripted_llm, fake_tools):
+    set_global("jwtKey", JWT_KEY)
+    fake_tools({"kubectl": lambda c: "default\nkube-system"})
+    scripted_llm(
+        [
+            json.dumps(
+                {
+                    "question": "q",
+                    "thought": "list",
+                    "action": {"name": "kubectl", "input": "get ns --no-headers"},
+                    "observation": "",
+                    "final_answer": "",
+                }
+            ),
+            json.dumps(
+                {
+                    "question": "q",
+                    "thought": "count",
+                    "action": {"name": "", "input": ""},
+                    "observation": "default\nkube-system",
+                    "final_answer": "There are 2 namespaces in the cluster.",
+                }
+            ),
+        ]
+    )
+
+    async def scenario():
+        client = await _client()
+        try:
+            token = issue_token("admin", JWT_KEY)
+            headers = {"Authorization": f"Bearer {token}", "X-API-Key": "k"}
+            r = await client.post(
+                "/api/execute?show-thought=true",
+                json={
+                    "instructions": "count namespaces",
+                    "args": "",
+                    "currentModel": "fake://m",
+                },
+                headers=headers,
+            )
+            assert r.status == 200
+            data = await r.json()
+            assert data["status"] == "success"
+            assert data["message"] == "There are 2 namespaces in the cluster."
+            assert data["tools_history"][0]["name"] == "kubectl"
+            assert "kube-system" in data["tools_history"][0]["observation"]
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_execute_missing_api_key():
+    set_global("jwtKey", JWT_KEY)
+
+    async def scenario():
+        client = await _client()
+        try:
+            token = issue_token("admin", JWT_KEY)
+            r = await client.post(
+                "/api/execute",
+                json={"instructions": "x", "args": ""},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert r.status == 400
+            assert "API Key" in (await r.json())["error"]
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_perf_endpoints(scripted_llm):
+    set_global("jwtKey", JWT_KEY)
+
+    async def scenario():
+        client = await _client()
+        try:
+            token = issue_token("admin", JWT_KEY)
+            headers = {"Authorization": f"Bearer {token}"}
+            r = await client.get("/api/perf/stats", headers=headers)
+            assert r.status == 200
+            assert "stats" in await r.json()
+            r = await client.post("/api/perf/reset", headers=headers)
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    run(scenario())

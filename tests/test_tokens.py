@@ -1,0 +1,61 @@
+"""Tests for token accounting (reference pkg/llms/tokens_test.go is the model)."""
+
+from opsagent_tpu.llm.tokens import (
+    constrict_messages,
+    constrict_prompt,
+    count_tokens,
+    get_token_limits,
+    num_tokens_from_messages,
+)
+
+
+def test_token_limits_table():
+    assert get_token_limits("gpt-4") == 8192
+    assert get_token_limits("gpt-4-32k") == 32768
+    assert get_token_limits("gpt-3.5-turbo") == 16384
+    assert get_token_limits("qwen-plus") == 131072
+    assert get_token_limits("tpu://llama3-8b") == 131072
+    assert get_token_limits("unknown-model") == 4096
+
+
+def test_longest_prefix_wins():
+    assert get_token_limits("gpt-4-turbo-2024") == 128000
+
+
+def test_count_tokens_monotone():
+    assert count_tokens("hello world") < count_tokens("hello world " * 50)
+
+
+def test_num_tokens_from_messages_overhead():
+    msgs = [{"role": "user", "content": "hi"}]
+    # 3 per message + 3 priming + content tokens
+    assert num_tokens_from_messages(msgs) >= 6
+
+
+def test_constrict_messages_evicts_oldest_non_system():
+    msgs = [{"role": "system", "content": "sys"}]
+    for i in range(50):
+        msgs.append({"role": "user", "content": f"message {i} " + "filler " * 200})
+    out = constrict_messages(msgs, "unknown-model", max_tokens=1024)
+    assert out[0]["role"] == "system"
+    assert len(out) < len(msgs)
+    # the newest message survives
+    assert out[-1]["content"] == msgs[-1]["content"]
+
+
+def test_constrict_prompt_keeps_tail():
+    lines = [f"line {i}" for i in range(3000)]
+    text = "\n".join(lines)
+    out = constrict_prompt(text, 100)
+    assert count_tokens(out) <= 100
+    assert out.endswith("line 2999")
+
+
+def test_constrict_prompt_single_long_line():
+    text = "x" * 100000
+    out = constrict_prompt(text, 50)
+    assert count_tokens(out) <= 60  # small tolerance for char-based cut
+
+
+def test_constrict_prompt_small_input_unchanged():
+    assert constrict_prompt("short", 100) == "short"

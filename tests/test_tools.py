@@ -1,0 +1,133 @@
+"""Tests for the tool layer."""
+
+import sys
+
+import pytest
+
+from opsagent_tpu.tools import ToolPrompt, ToolError, get_tools
+from opsagent_tpu.tools.jq import jq, _split_input
+from opsagent_tpu.tools.kubectl import filter_noise, _classify
+from opsagent_tpu.tools.python_tool import python_repl
+from opsagent_tpu.tools.trivy import trivy
+
+
+def test_toolprompt_roundtrip():
+    tp = ToolPrompt.from_json(
+        '{"question": "q", "thought": "t", '
+        '"action": {"name": "kubectl", "input": "get ns"}, '
+        '"observation": "", "final_answer": ""}'
+    )
+    assert tp.action.name == "kubectl"
+    tp.observation = "3 namespaces"
+    d = tp.to_dict()
+    assert d["action"]["input"] == "get ns"
+    assert d["observation"] == "3 namespaces"
+    again = ToolPrompt.from_json(tp.to_json())
+    assert again.observation == "3 namespaces"
+
+
+def test_toolprompt_tolerates_action_string():
+    tp = ToolPrompt.from_json('{"action": "kubectl", "thought": "t"}')
+    assert tp.action.name == "kubectl"
+
+
+def test_toolprompt_tolerates_sloppy_json():
+    tp = ToolPrompt.from_json(
+        '```json\n{"thought": "multi\nline", "final_answer": "done",}\n```'
+    )
+    assert tp.final_answer == "done"
+    assert tp.thought == "multi\nline"
+
+
+def test_registry_contents():
+    tools = get_tools()
+    for name in ("kubectl", "python", "trivy", "jq", "search"):
+        assert name in tools
+
+
+def test_python_tool_runs():
+    assert python_repl("print(21 * 2)") == "42"
+
+
+def test_python_tool_error():
+    with pytest.raises(ToolError):
+        python_repl("raise RuntimeError('boom')")
+
+
+def test_python_tool_uses_argv_not_shell():
+    # Quotes and shell metacharacters must pass through untouched.
+    out = python_repl("""print('he said "hi"; $(ls)')""")
+    assert out == 'he said "hi"; $(ls)'
+
+
+def test_jq_split_on_top_level_pipe_only():
+    data, expr = _split_input('{"a": "x|y"} | .a')
+    assert data == '{"a": "x|y"}'
+    assert expr == ".a"
+
+
+def test_jq_invalid_json():
+    with pytest.raises(ToolError):
+        jq("not json | .a")
+
+
+def test_jq_no_pipe():
+    with pytest.raises(ToolError):
+        jq('{"a": 1}')
+
+
+def test_jq_fallback_path_eval(monkeypatch):
+    # Force the built-in evaluator even when a jq binary exists.
+    import subprocess
+
+    def no_jq(*a, **k):
+        raise FileNotFoundError("jq")
+
+    monkeypatch.setattr(subprocess, "run", no_jq)
+    assert jq('{"a": {"b": [10, 20]}} | .a.b[1]') == "20"
+    assert jq('{"items": [{"n": 1}, {"n": 2}]} | .items[].n') == "1\n2"
+    assert jq('[1, 2, 3] | length') == "3"
+
+
+def test_kubectl_classify():
+    assert _classify("kubectl get pods") == "get"
+    assert _classify("kubectl -n kube-system describe pod x") == "describe"
+    assert _classify("kubectl logs x --tail=10") == "logs"
+
+
+def test_kubectl_noise_filter():
+    noisy = (
+        "NAME   READY\n"
+        "web-1  1/1\n"
+        "E0307 12:00:00.123456 couldn't reach metrics server\n"
+        "couldn't get current server API group list: timeout\n"
+    )
+    out = filter_noise(noisy)
+    assert "web-1" in out
+    assert "E0307" not in out
+    assert "API group list" not in out
+
+
+def test_trivy_strips_image_prefix(monkeypatch):
+    import subprocess
+
+    captured = {}
+
+    def fake_run(argv, **kw):
+        captured["argv"] = argv
+
+        class R:
+            returncode = 0
+            stdout = "no vulns"
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert trivy("image nginx:1.25") == "no vulns"
+    assert captured["argv"][:3] == ["trivy", "image", "nginx:1.25"]
+
+
+def test_trivy_empty_image():
+    with pytest.raises(ToolError):
+        trivy("   ")

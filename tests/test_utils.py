@@ -1,0 +1,85 @@
+"""Tests for perf stats, config, global store, yaml/term utils."""
+
+import threading
+
+from opsagent_tpu.utils.config import load_config, reset_config
+from opsagent_tpu.utils.globalstore import get_global, set_global, delete_global
+from opsagent_tpu.utils.perf import PerfStats
+from opsagent_tpu.utils.term import render_markdown
+from opsagent_tpu.utils.yamlutil import extract_yaml
+
+
+def test_global_store():
+    set_global("k", 42)
+    assert get_global("k") == 42
+    delete_global("k")
+    assert get_global("k", "gone") == "gone"
+
+
+def test_perf_timer_and_summary():
+    ps = PerfStats()
+    for _ in range(10):
+        ps.start_timer("op")
+        ps.stop_timer("op")
+    ps.record_metric("tokens", 100, "tok")
+    ps.set_gauge("tok_per_sec", 1234.5)
+    stats = ps.get_stats()
+    assert stats["op"]["count"] == 10
+    assert stats["op"]["p95"] >= stats["op"]["min"]
+    assert stats["tokens"]["unit"] == "tok"
+    assert stats["gauges"]["tok_per_sec"] == 1234.5
+    table = ps.format_table()
+    assert "op" in table
+    ps.reset()
+    assert ps.get_stats() == {}
+
+
+def test_perf_thread_safety():
+    ps = PerfStats()
+
+    def work(i):
+        for j in range(200):
+            ps.record_metric(f"m{i % 3}", j)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(s["count"] for s in ps.get_stats().values())
+    assert total == 8 * 200
+
+
+def test_config_defaults(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    reset_config()
+    cfg = load_config()
+    assert cfg["server"]["port"] == 8080
+    assert cfg["perf"]["enabled"] is True
+    assert cfg["serving"]["page_size"] == 16
+
+
+def test_config_file_overrides(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "configs").mkdir()
+    (tmp_path / "configs" / "config.yaml").write_text(
+        "server:\n  port: 9999\njwt:\n  key: custom\n"
+    )
+    reset_config()
+    cfg = load_config()
+    assert cfg["server"]["port"] == 9999
+    assert cfg["jwt"]["key"] == "custom"
+    assert cfg["log"]["level"] == "info"  # defaults preserved
+    reset_config()
+
+
+def test_extract_yaml():
+    text = "Here:\n```yaml\nkind: Pod\nmetadata:\n  name: x\n```\ndone"
+    assert extract_yaml(text) == "kind: Pod\nmetadata:\n  name: x\n"
+    assert extract_yaml("no fence") == "no fence"
+
+
+def test_render_markdown_plain():
+    out = render_markdown("# Title\n- item\n`code`\n", color=False)
+    assert "TITLE" in out
+    assert "• item" in out
