@@ -82,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
     se.add_argument("--tokenizer", default="", help="HF tokenizer path (else byte tokenizer)")
     se.add_argument("--tp", type=int, default=0, help="tensor-parallel size (0 = all devices)")
     se.add_argument("--max-batch-size", type=int, default=8)
+    se.add_argument(
+        "--platform",
+        default="",
+        choices=("", "tpu", "cpu"),
+        help="force the JAX platform (default: environment's choice)",
+    )
 
     return p
 
@@ -120,6 +126,12 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.command == "serve-engine":
+        if args.platform:
+            # jax may already be imported (TPU-plugin sitecustomize), so the
+            # config update is the only reliable override.
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
         try:
             from ..serving.api import run_engine_server
         except ImportError as e:

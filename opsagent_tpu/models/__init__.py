@@ -1,0 +1,3 @@
+from .config import ModelConfig, get_config_preset, PRESETS
+
+__all__ = ["ModelConfig", "get_config_preset", "PRESETS"]

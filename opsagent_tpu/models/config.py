@@ -1,0 +1,228 @@
+"""Model family configurations.
+
+The serving engine hosts open-weights instruct models in the llama
+architecture family (Llama-3, Qwen2.5 — GQA + RoPE + SwiGLU + RMSNorm) and,
+via ``moe``, DeepSeek-style mixture-of-experts variants. Presets carry the
+published architecture hyperparameters; weights are loaded from safetensors
+checkpoints or randomly initialized (tests/benchmarks).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    num_experts_per_token: int = 2
+    num_shared_experts: int = 0
+    expert_intermediate_size: int = 0  # 0 = use model intermediate_size
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int = 0  # 0 = hidden_size // num_heads
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    attn_bias: bool = False          # Qwen2-style q/k/v biases
+    tie_embeddings: bool = False
+    max_position: int = 131072
+    moe: Optional[MoEConfig] = None
+    moe_layer_start: int = 0         # dense layers before the first MoE layer
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim_
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim_
+
+    def num_params(self) -> int:
+        """Approximate parameter count (dense layers)."""
+        d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = (
+            d * self.q_size + 2 * d * self.kv_size + self.q_size * d  # attn
+            + 3 * d * f                                               # mlp
+            + 2 * d                                                   # norms
+        )
+        embed = v * d * (1 if self.tie_embeddings else 2)
+        return self.num_layers * per_layer + embed + d
+
+
+PRESETS: dict[str, ModelConfig] = {}
+
+
+def _register(cfg: ModelConfig) -> ModelConfig:
+    PRESETS[cfg.name] = cfg
+    return cfg
+
+
+# -- test/bench models ------------------------------------------------------
+TINY_TEST = _register(
+    ModelConfig(
+        name="tiny-test",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        rope_theta=10000.0,
+        max_position=2048,
+    )
+)
+
+# ~1B-class model for single-chip benchmarking (fits v5e 16GB in bf16 with
+# room for KV pages).
+BENCH_1B = _register(
+    ModelConfig(
+        name="bench-1b",
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=500000.0,
+    )
+)
+
+# ~3B-class single-chip bench model.
+BENCH_3B = _register(
+    ModelConfig(
+        name="bench-3b",
+        vocab_size=128256,
+        hidden_size=3072,
+        intermediate_size=8192,
+        num_layers=28,
+        num_heads=24,
+        num_kv_heads=8,
+        rope_theta=500000.0,
+    )
+)
+
+# -- production model families (published architecture hyperparameters) -----
+LLAMA3_8B = _register(
+    ModelConfig(
+        name="llama-3-8b-instruct",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=500000.0,
+        max_position=8192,
+    )
+)
+
+LLAMA31_70B = _register(
+    ModelConfig(
+        name="llama-3.1-70b-instruct",
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        rope_theta=500000.0,
+    )
+)
+
+QWEN25_7B = _register(
+    ModelConfig(
+        name="qwen2.5-7b-instruct",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        rope_theta=1000000.0,
+        attn_bias=True,
+        rms_norm_eps=1e-6,
+    )
+)
+
+QWEN25_72B = _register(
+    ModelConfig(
+        name="qwen2.5-72b-instruct",
+        vocab_size=152064,
+        hidden_size=8192,
+        intermediate_size=29568,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        rope_theta=1000000.0,
+        attn_bias=True,
+        rms_norm_eps=1e-6,
+    )
+)
+
+# DeepSeek-V2-lite-style MoE (stand-in for the DeepSeek function-calling
+# config; V3's MLA attention lands with the MoE milestone).
+DEEPSEEK_MOE_16B = _register(
+    ModelConfig(
+        name="deepseek-moe-16b",
+        vocab_size=102400,
+        hidden_size=2048,
+        intermediate_size=10944,
+        num_layers=28,
+        num_heads=16,
+        num_kv_heads=16,
+        rope_theta=10000.0,
+        moe=MoEConfig(
+            num_experts=64,
+            num_experts_per_token=6,
+            num_shared_experts=2,
+            expert_intermediate_size=1408,
+        ),
+        moe_layer_start=1,
+    )
+)
+
+TINY_MOE = _register(
+    ModelConfig(
+        name="tiny-moe",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        rope_theta=10000.0,
+        max_position=2048,
+        moe=MoEConfig(
+            num_experts=4,
+            num_experts_per_token=2,
+            num_shared_experts=1,
+            expert_intermediate_size=64,
+        ),
+        moe_layer_start=1,
+    )
+)
+
+
+def get_config_preset(name: str) -> ModelConfig:
+    if name in PRESETS:
+        return PRESETS[name]
+    raise KeyError(f"unknown model preset '{name}' (have: {sorted(PRESETS)})")
+
+
+def scaled_for_test(cfg: ModelConfig, vocab_size: int = 512) -> ModelConfig:
+    """Shrink a preset's vocab for fast CPU tests, keeping its shape ratios."""
+    return replace(cfg, vocab_size=vocab_size)

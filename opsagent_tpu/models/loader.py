@@ -1,0 +1,165 @@
+"""Checkpoint loading: HuggingFace safetensors -> stacked param pytree.
+
+Maps the HF llama-family naming scheme (model.layers.N.self_attn.q_proj...)
+onto this framework's scan-stacked layout (models/llama.py): per-layer weights
+are transposed to [in, out] and stacked along a leading layer dim. Handles
+single-file and index-sharded checkpoints. Supports Llama-3 and Qwen2.5
+families (attention biases included when present).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+
+class CheckpointError(Exception):
+    pass
+
+
+def _open_shards(path: str):
+    """Yield (name, tensor-loader) for every tensor across all shards."""
+    from safetensors import safe_open
+
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.isfile(index):
+            with open(index, "r", encoding="utf-8") as f:
+                weight_map: dict[str, str] = json.load(f)["weight_map"]
+            files = sorted({os.path.join(path, v) for v in weight_map.values()})
+        else:
+            files = sorted(
+                os.path.join(path, f)
+                for f in os.listdir(path)
+                if f.endswith(".safetensors")
+            )
+    if not files:
+        raise CheckpointError(f"no .safetensors files under {path}")
+    tensors: dict[str, Any] = {}
+    for file in files:
+        fh = safe_open(file, framework="numpy")
+        for name in fh.keys():
+            tensors[name] = (fh, name)
+    return tensors
+
+
+def _get(tensors: dict[str, Any], name: str) -> np.ndarray:
+    if name not in tensors:
+        raise CheckpointError(f"missing tensor {name} in checkpoint")
+    fh, key = tensors[name]
+    return fh.get_tensor(key)
+
+
+def _maybe(tensors: dict[str, Any], name: str) -> np.ndarray | None:
+    if name not in tensors:
+        return None
+    fh, key = tensors[name]
+    return fh.get_tensor(key)
+
+
+def load_checkpoint(
+    path: str, cfg: ModelConfig, dtype: Any = jnp.bfloat16
+) -> dict[str, Any]:
+    """Load an HF llama/qwen checkpoint into the stacked param layout."""
+    tensors = _open_shards(path)
+    L = cfg.num_layers
+
+    def linear(name_fmt: str) -> jnp.ndarray:
+        # HF stores [out, in]; we use [in, out]. Stack over layers.
+        mats = [
+            _get(tensors, name_fmt.format(i)).T for i in range(L)
+        ]
+        return jnp.asarray(np.stack(mats), dtype=dtype)
+
+    def vector(name_fmt: str) -> jnp.ndarray:
+        vecs = [_get(tensors, name_fmt.format(i)) for i in range(L)]
+        return jnp.asarray(np.stack(vecs), dtype=dtype)
+
+    layers: dict[str, Any] = {
+        "attn_norm": vector("model.layers.{}.input_layernorm.weight"),
+        "wq": linear("model.layers.{}.self_attn.q_proj.weight"),
+        "wk": linear("model.layers.{}.self_attn.k_proj.weight"),
+        "wv": linear("model.layers.{}.self_attn.v_proj.weight"),
+        "wo": linear("model.layers.{}.self_attn.o_proj.weight"),
+        "mlp_norm": vector("model.layers.{}.post_attention_layernorm.weight"),
+        "wg": linear("model.layers.{}.mlp.gate_proj.weight"),
+        "wu": linear("model.layers.{}.mlp.up_proj.weight"),
+        "wd": linear("model.layers.{}.mlp.down_proj.weight"),
+    }
+    if cfg.attn_bias:
+        layers["bq"] = vector("model.layers.{}.self_attn.q_proj.bias")
+        layers["bk"] = vector("model.layers.{}.self_attn.k_proj.bias")
+        layers["bv"] = vector("model.layers.{}.self_attn.v_proj.bias")
+
+    params: dict[str, Any] = {
+        "embed": jnp.asarray(_get(tensors, "model.embed_tokens.weight"), dtype=dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(_get(tensors, "model.norm.weight"), dtype=dtype),
+    }
+    head = _maybe(tensors, "lm_head.weight")
+    if cfg.tie_embeddings or head is None:
+        if not cfg.tie_embeddings and head is None:
+            raise CheckpointError(
+                "checkpoint has no lm_head.weight but config does not tie embeddings"
+            )
+    else:
+        params["lm_head"] = jnp.asarray(head.T, dtype=dtype)
+
+    # Shape validation against the config.
+    v, d = params["embed"].shape
+    if v != cfg.vocab_size or d != cfg.hidden_size:
+        raise CheckpointError(
+            f"embed shape {(v, d)} does not match config "
+            f"({cfg.vocab_size}, {cfg.hidden_size})"
+        )
+    return params
+
+
+def save_checkpoint(path: str, params: dict[str, Any]) -> None:
+    """Write params back out as a single HF-style safetensors file (testing
+    and fine-tune export)."""
+    from safetensors.numpy import save_file
+
+    flat: dict[str, np.ndarray] = {}
+    L = params["layers"]["wq"].shape[0]
+    name_map = {
+        "attn_norm": "model.layers.{}.input_layernorm.weight",
+        "wq": "model.layers.{}.self_attn.q_proj.weight",
+        "wk": "model.layers.{}.self_attn.k_proj.weight",
+        "wv": "model.layers.{}.self_attn.v_proj.weight",
+        "wo": "model.layers.{}.self_attn.o_proj.weight",
+        "mlp_norm": "model.layers.{}.post_attention_layernorm.weight",
+        "wg": "model.layers.{}.mlp.gate_proj.weight",
+        "wu": "model.layers.{}.mlp.up_proj.weight",
+        "wd": "model.layers.{}.mlp.down_proj.weight",
+        "bq": "model.layers.{}.self_attn.q_proj.bias",
+        "bk": "model.layers.{}.self_attn.k_proj.bias",
+        "bv": "model.layers.{}.self_attn.v_proj.bias",
+    }
+    for key, fmt in name_map.items():
+        if key not in params["layers"]:
+            continue
+        stacked = np.asarray(params["layers"][key].astype(jnp.float32))
+        for i in range(L):
+            mat = stacked[i]
+            if mat.ndim == 2:
+                mat = mat.T  # back to HF [out, in]
+            flat[fmt.format(i)] = np.ascontiguousarray(mat)
+    flat["model.embed_tokens.weight"] = np.asarray(
+        params["embed"].astype(jnp.float32)
+    )
+    flat["model.norm.weight"] = np.asarray(params["final_norm"].astype(jnp.float32))
+    if "lm_head" in params:
+        flat["lm_head.weight"] = np.ascontiguousarray(
+            np.asarray(params["lm_head"].astype(jnp.float32)).T
+        )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    save_file(flat, path)

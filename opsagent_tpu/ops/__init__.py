@@ -1,0 +1,14 @@
+from .rope import apply_rope, rope_table
+from .attention import (
+    causal_prefill_attention,
+    paged_decode_attention,
+    write_kv_pages,
+)
+
+__all__ = [
+    "apply_rope",
+    "rope_table",
+    "causal_prefill_attention",
+    "paged_decode_attention",
+    "write_kv_pages",
+]

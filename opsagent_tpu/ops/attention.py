@@ -1,0 +1,132 @@
+"""Attention ops: prefill (causal GQA) and paged-KV decode.
+
+These are the XLA reference implementations — correct on any backend and the
+ground truth for the Pallas TPU kernels in ``paged_attention_pallas.py``.
+Softmax accumulates in float32 regardless of the activation dtype (bf16 on
+TPU) for numerical parity with the fused kernels.
+
+The paged layout: KV lives in fixed-size pages ``[num_pages, page_size,
+num_kv_heads, head_dim]``; a sequence owns a row of the page table
+``[max_pages_per_seq]`` holding page indices. This is the structure the
+continuous-batching scheduler allocates against (SURVEY.md section 7 step 5 /
+the Ragged-Paged-Attention design in PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def causal_prefill_attention(
+    q: jax.Array,        # [B, S, H, D]
+    k: jax.Array,        # [B, S, K, D]
+    v: jax.Array,        # [B, S, K, D]
+    lengths: jax.Array | None = None,  # [B] valid lengths (right padding)
+) -> jax.Array:
+    """Causal grouped-query attention over the in-flight (fresh) K/V."""
+    B, S, H, D = q.shape
+    K = k.shape[2]
+    G = H // K
+    scale = 1.0 / (D ** 0.5)
+    qg = q.reshape(B, S, K, G, D)
+    # MXU-native matmul in the input dtype, f32 accumulation.
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale
+    pos_q = jnp.arange(S)[:, None]
+    pos_t = jnp.arange(S)[None, :]
+    mask = pos_t <= pos_q  # [S, S]
+    mask = mask[None, None, None, :, :]
+    if lengths is not None:
+        tvalid = (jnp.arange(S)[None, :] < lengths[:, None])[:, None, None, None, :]
+        mask = jnp.logical_and(mask, tvalid)
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgst,btkd->bskgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def write_kv_pages(
+    k_pages: jax.Array,     # [N, P, K, D]
+    v_pages: jax.Array,     # [N, P, K, D]
+    k_new: jax.Array,       # [B, S, K, D]
+    v_new: jax.Array,       # [B, S, K, D]
+    page_table: jax.Array,  # [B, MaxP] int32 page indices (-1 = unassigned)
+    start: jax.Array,       # [B] int32 write offset (tokens already in cache)
+    valid_len: jax.Array | None = None,  # [B] number of valid new tokens
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter freshly-computed K/V into their sequences' pages.
+
+    Token t of sequence b lands at flat slot ``page_table[b, (start[b]+t)//P]
+    * P + (start[b]+t) % P``. Out-of-range/padded tokens get an
+    out-of-bounds index and are dropped by the scatter (negative indices
+    would WRAP under JAX indexing semantics, so the sentinel is N*P).
+    """
+    N, P, K, D = k_pages.shape
+    B, S = k_new.shape[:2]
+    oob = N * P  # drop sentinel: one past the last flat slot
+    pos = start[:, None] + jnp.arange(S)[None, :]          # [B, S]
+    page_idx = jnp.take_along_axis(
+        page_table, jnp.clip(pos // P, 0, page_table.shape[1] - 1), axis=1
+    )                                                       # [B, S]
+    flat = page_idx * P + pos % P                           # [B, S]
+    if valid_len is not None:
+        ok = jnp.arange(S)[None, :] < valid_len[:, None]
+        flat = jnp.where(ok & (page_idx >= 0), flat, oob)
+    else:
+        flat = jnp.where(page_idx >= 0, flat, oob)
+    flat = flat.reshape(B * S)
+    kf = k_pages.reshape(N * P, K, D)
+    vf = v_pages.reshape(N * P, K, D)
+    kf = kf.at[flat].set(k_new.reshape(B * S, K, D), mode="drop")
+    vf = vf.at[flat].set(v_new.reshape(B * S, K, D), mode="drop")
+    return kf.reshape(N, P, K, D), vf.reshape(N, P, K, D)
+
+
+def paged_decode_attention(
+    q: jax.Array,           # [B, H, D] (one new token per sequence)
+    k_pages: jax.Array,     # [N, P, K, D]
+    v_pages: jax.Array,     # [N, P, K, D]
+    page_table: jax.Array,  # [B, MaxP]
+    lengths: jax.Array,     # [B] total tokens in cache (incl. the new one)
+) -> jax.Array:
+    """Decode-step attention over paged KV (gather-based XLA reference).
+
+    Gathers each sequence's pages into a contiguous [B, MaxP*P] view and
+    masks positions >= length. The Pallas kernel avoids this materialized
+    gather; results must match to ~1e-2 in bf16 / 1e-5 in f32.
+    """
+    N, P, K, D = k_pages.shape
+    B, H, _ = q.shape
+    G = H // K
+    MaxP = page_table.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    safe_table = jnp.clip(page_table, 0, N - 1)
+    k_seq = k_pages[safe_table]                    # [B, MaxP, P, K, D]
+    v_seq = v_pages[safe_table]
+    L = MaxP * P
+    k_seq = k_seq.reshape(B, L, K, D)
+    v_seq = v_seq.reshape(B, L, K, D)
+    qg = q.reshape(B, K, G, D)
+    scores = jnp.einsum(
+        "bkgd,blkd->bkgl", qg, k_seq, preferred_element_type=jnp.float32
+    ) * scale
+    valid = (jnp.arange(L)[None, :] < lengths[:, None])[:, None, None, :]
+    scores = jnp.where(valid, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgl,blkd->bkgd",
+        probs.astype(v_seq.dtype),
+        v_seq,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, H, D).astype(q.dtype)

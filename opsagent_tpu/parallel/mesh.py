@@ -1,0 +1,82 @@
+"""Device mesh and sharding utilities.
+
+The reference has no distributed layer at all (SURVEY.md section 2.2); this
+module is the foundation of the new framework's TPU story: a named
+``jax.sharding.Mesh`` with axes
+
+- ``dp``  — data/batch parallel (concurrent agent sessions),
+- ``tp``  — tensor parallel (attention heads / MLP hidden, over ICI),
+- ``sp``  — sequence/context parallel (long-context prefill, ring attention).
+
+All model code expresses placement as ``PartitionSpec`` trees over these axis
+names; XLA inserts the collectives (psum / all-gather / reduce-scatter) from
+the shardings — there is no hand-written NCCL-style backend to port.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshAxes:
+    dp: str = "dp"
+    tp: str = "tp"
+    sp: str = "sp"
+
+
+AXES = MeshAxes()
+
+
+def make_mesh(
+    tp: int | None = None,
+    dp: int = 1,
+    sp: int = 1,
+    devices: list[Any] | None = None,
+) -> Mesh:
+    """Build a (dp, sp, tp) mesh. ``tp=None`` uses all remaining devices.
+
+    On a single host this is the v5e slice over ICI; across hosts
+    ``jax.distributed.initialize`` extends the same mesh over DCN with dp/pp
+    as the outer (slow) axes, which is why dp is the leading mesh dim.
+    """
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    if tp is None or tp <= 0:
+        if n % (dp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by dp*sp={dp * sp}")
+        tp = n // (dp * sp)
+    need = dp * sp * tp
+    if need > n:
+        raise ValueError(f"mesh dp={dp} sp={sp} tp={tp} needs {need} devices, have {n}")
+    grid = np.array(devs[:need]).reshape(dp, sp, tp)
+    return Mesh(grid, (AXES.dp, AXES.sp, AXES.tp))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def shard_params(params: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a parameter pytree onto the mesh according to a matching pytree
+    of PartitionSpecs (device_put handles resharding/replication)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def spec_tree_shardings(specs: Any, mesh: Mesh) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,2 @@
+"""TPU serving engine: the in-tree replacement for the reference's remote
+LLM providers (SURVEY.md section 7)."""

@@ -1,0 +1,355 @@
+"""OpenAI-compatible frontend for the serving engine.
+
+Two access paths, one implementation:
+
+- **In-process provider**: registered under the ``tpu://`` scheme with the
+  agent's ChatClient (llm/client.py), so ``--model tpu://tiny-test`` routes
+  the ReAct loop straight into the engine with zero HTTP hops and zero
+  external API calls (BASELINE.json north_star).
+- **HTTP server**: ``opsagent serve-engine`` exposes POST
+  /v1/chat/completions (non-streaming and SSE streaming), GET /v1/models and
+  /healthz for out-of-process clients speaking the unchanged OpenAI wire
+  format.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+import time
+import uuid
+from typing import Any
+
+from ..llm.client import register_provider
+from ..utils.jsonrepair import parse_json
+from ..utils.logger import get_logger
+from .chat_template import apply_chat_template
+from .engine import Engine, EngineConfig
+from .sampler import SamplingParams
+from .scheduler import Request, Scheduler
+
+log = get_logger("serving.api")
+
+
+class ServingStack:
+    """Engine + scheduler + chat glue for one hosted model."""
+
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self.scheduler = Scheduler(engine)
+        self.scheduler.start()
+        self.model_name = engine.model_cfg.name
+
+    # -- request translation ------------------------------------------------
+    def _sampling_from(self, body: dict[str, Any]) -> SamplingParams:
+        return SamplingParams(
+            temperature=float(body.get("temperature", 0.0) or 0.0),
+            top_k=int(body.get("top_k", 0) or 0),
+            top_p=float(body.get("top_p", 1.0) or 1.0),
+            max_tokens=int(
+                body.get("max_tokens") or self.engine.cfg.max_new_tokens_default
+            ),
+            stop=tuple(
+                [body["stop"]] if isinstance(body.get("stop"), str)
+                else body.get("stop") or []
+            ),
+        )
+
+    def _prompt_ids(self, body: dict[str, Any]) -> list[int]:
+        return apply_chat_template(
+            self.engine.tokenizer,
+            body.get("messages", []),
+            model_family=self.model_name,
+            tools=body.get("tools"),
+        )
+
+    def _finalize_text(
+        self, tokens: list[int], stop: tuple[str, ...], finish_reason: str = ""
+    ) -> tuple[str, str]:
+        """(text, finish_reason) with eos/stop-string trimming."""
+        eos = self.engine.tokenizer.eos_id
+        finish = finish_reason or "length"
+        if tokens and tokens[-1] == eos:
+            tokens = tokens[:-1]
+            finish = "stop"
+        text = self.engine.tokenizer.decode(tokens)
+        for s in stop:
+            idx = text.find(s)
+            if idx >= 0:
+                text = text[:idx]
+                finish = "stop"
+        return text, finish
+
+    @staticmethod
+    def _parse_tool_calls(text: str) -> list[dict[str, Any]] | None:
+        t = text.strip()
+        if '"tool_calls"' not in t:
+            return None
+        try:
+            obj = parse_json(t)
+        except ValueError:
+            return None
+        calls = obj.get("tool_calls") if isinstance(obj, dict) else None
+        if not isinstance(calls, list) or not calls:
+            return None
+        out = []
+        for i, c in enumerate(calls):
+            fn = c.get("function", {}) if isinstance(c, dict) else {}
+            args = fn.get("arguments", "")
+            if not isinstance(args, str):
+                args = json.dumps(args, ensure_ascii=False)
+            out.append(
+                {
+                    "id": c.get("id") or f"call_{i}",
+                    "type": "function",
+                    "function": {"name": fn.get("name", ""), "arguments": args},
+                }
+            )
+        return out
+
+    # -- chat.completions ---------------------------------------------------
+    def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
+        sampling = self._sampling_from(body)
+        prompt_ids = self._prompt_ids(body)
+        t0 = time.time()
+        req = Request(prompt_ids, sampling)
+        self.scheduler.submit(req)
+        if not req.done.wait(600):
+            raise TimeoutError("generation timed out")
+        if req.error:
+            raise RuntimeError(req.error)
+        tokens = req.tokens
+        text, finish = self._finalize_text(tokens, sampling.stop, req.finish_reason)
+        tool_calls = self._parse_tool_calls(text)
+        message: dict[str, Any] = {"role": "assistant", "content": text}
+        if tool_calls:
+            message = {"role": "assistant", "content": None, "tool_calls": tool_calls}
+            finish = "tool_calls"
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(t0),
+            "model": body.get("model") or self.model_name,
+            "choices": [
+                {"index": 0, "message": message, "finish_reason": finish}
+            ],
+            "usage": {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": len(tokens),
+                "total_tokens": len(prompt_ids) + len(tokens),
+            },
+        }
+
+    def chat_completion_stream(self, body: dict[str, Any]):
+        """Generator of SSE chunk dicts (sync; drive from a thread)."""
+        sampling = self._sampling_from(body)
+        prompt_ids = self._prompt_ids(body)
+        token_q: "queue.Queue[int | None]" = queue.Queue()
+        req = Request(
+            prompt_ids, sampling, on_token=lambda t: token_q.put(t)
+        )
+        self.scheduler.submit(req)
+        created = int(time.time())
+        cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        model = body.get("model") or self.model_name
+        eos = self.engine.tokenizer.eos_id
+        sent: list[int] = []
+
+        def chunk(delta: dict[str, Any], finish: str | None = None):
+            return {
+                "id": cid,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": model,
+                "choices": [
+                    {"index": 0, "delta": delta, "finish_reason": finish}
+                ],
+            }
+
+        yield chunk({"role": "assistant", "content": ""})
+        watchdog = threading.Thread(
+            target=lambda: (req.done.wait(600), token_q.put(None)), daemon=True
+        )
+        watchdog.start()
+        # Incremental UTF-8-safe decoding: decode the cumulative token list
+        # and emit only the new suffix, withholding trailing bytes that do
+        # not yet form a complete character (multi-byte chars can span
+        # tokens with byte-level vocabularies).
+        emitted = ""
+        stopped = False
+        while True:
+            tok = token_q.get()
+            if tok is None:
+                break
+            if tok == eos or stopped:
+                continue
+            sent.append(tok)
+            text = self.engine.tokenizer.decode(sent)
+            if text.endswith("�"):
+                continue  # incomplete multi-byte tail; wait for more tokens
+            for s in sampling.stop:
+                idx = text.find(s)
+                if idx >= 0:
+                    text = text[:idx]
+                    stopped = True
+                    break
+            delta = text[len(emitted) :]
+            if delta:
+                yield chunk({"content": delta})
+                emitted = text
+        if req.error:
+            yield {"error": {"message": req.error}}
+            return
+        finish = "stop" if stopped else (req.finish_reason or "length")
+        yield chunk({}, finish=finish)
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        self.scheduler.stop()
+
+
+# -- in-process tpu:// provider ---------------------------------------------
+_stacks: dict[str, ServingStack] = {}
+_stacks_lock = threading.Lock()
+
+
+def install_stack(name: str, stack: ServingStack) -> None:
+    """Register an engine under a tpu:// model name (tests, co-hosting)."""
+    with _stacks_lock:
+        _stacks[name] = stack
+
+
+def get_stack(name: str) -> ServingStack:
+    # Engine construction happens under the lock: two racing first requests
+    # must not each build a device-resident engine (the loser would leak
+    # device memory and a scheduler thread).
+    with _stacks_lock:
+        if name not in _stacks:
+            log.info("creating in-process engine for tpu://%s", name)
+            _stacks[name] = ServingStack(Engine(EngineConfig(model=name)))
+        return _stacks[name]
+
+
+def _tpu_provider_factory(target: str):
+    from ..llm.client import LLMError
+
+    def provider(body: dict[str, Any]) -> dict[str, Any]:
+        stack = get_stack(target or body.get("model", ""))
+        try:
+            return stack.chat_completion(body)
+        except Exception as e:  # noqa: BLE001 - agent loop handles LLMError
+            raise LLMError(f"tpu engine error: {e}") from e
+
+    return provider
+
+
+register_provider("tpu", _tpu_provider_factory)
+
+
+# -- HTTP server -------------------------------------------------------------
+def build_engine_app(stack: ServingStack):
+    from aiohttp import web
+
+    async def models(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": stack.model_name,
+                        "object": "model",
+                        "owned_by": "opsagent-tpu",
+                    }
+                ],
+            }
+        )
+
+    async def healthz(request: web.Request) -> web.Response:
+        eng = stack.engine
+        return web.json_response(
+            {
+                "status": "ok",
+                "model": stack.model_name,
+                "free_pages": eng.alloc.free_pages,
+                "running": len(eng.sequences),
+            }
+        )
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400
+            )
+        if not body.get("messages"):
+            return web.json_response(
+                {"error": {"message": "messages is required"}}, status=400
+            )
+        loop = asyncio.get_running_loop()
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                }
+            )
+            await resp.prepare(request)
+            gen = stack.chat_completion_stream(body)
+            while True:
+                chunk = await loop.run_in_executor(None, lambda: next(gen, None))
+                if chunk is None:
+                    break
+                await resp.write(
+                    b"data: " + json.dumps(chunk).encode("utf-8") + b"\n\n"
+                )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        try:
+            out = await loop.run_in_executor(None, stack.chat_completion, body)
+        except Exception as e:  # noqa: BLE001 - OpenAI-style error envelope
+            status = 400 if "prompt" in str(e).lower() else 500
+            return web.json_response(
+                {"error": {"message": str(e), "type": type(e).__name__}},
+                status=status,
+            )
+        return web.json_response(out)
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", completions)
+    app.router.add_get("/v1/models", models)
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
+def run_engine_server(
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    model_name: str = "tiny-test",
+    checkpoint: str = "",
+    tokenizer: str = "",
+    tp: int = 0,
+    max_batch_size: int = 8,
+) -> None:
+    from aiohttp import web
+
+    cfg = EngineConfig(
+        model=model_name,
+        checkpoint=checkpoint,
+        tokenizer=tokenizer,
+        tp=tp,
+        max_batch_size=max_batch_size,
+    )
+    engine = Engine(cfg)
+    stack = ServingStack(engine)
+    install_stack(model_name, stack)
+    app = build_engine_app(stack)
+
+    async def _announce(_) -> None:
+        log.info("serving engine listening on %s:%d (model=%s)", host, port, model_name)
+
+    app.on_startup.append(_announce)
+    web.run_app(app, host=host, port=port, print=None)

@@ -1,0 +1,313 @@
+"""The serving engine: jitted prefill/decode over a paged KV cache.
+
+This is the compute core that replaces the reference's remote LLM round trip
+(reference pkg/assistants/simple.go:343,515 -> pkg/llms/openai.go:69). Design
+points (SURVEY.md section 7):
+
+- **Two XLA programs**: prefill (one sequence, bucketed lengths) and a
+  fixed-batch decode step. Static shapes only — bucketing avoids
+  recompilation; the decode batch is padded with inactive slots.
+- **Paged KV cache**: device pages + host PageAllocator; the cache pytree is
+  donated through every call, so it lives in HBM with no copies.
+- **Tensor parallelism**: params/cache placed with NamedShardings over the
+  (dp, sp, tp) mesh; jit propagates, XLA emits the ICI collectives.
+- **Greedy-by-default sampling** on device, with per-request temperature /
+  top-k / top-p and a constrained-decoding mask hook.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from ..models.config import ModelConfig, get_config_preset
+from ..parallel.mesh import make_mesh, shard_params
+from ..utils.logger import get_logger
+from ..utils.perf import get_perf_stats
+from .kvcache import PageAllocator, OutOfPages
+from .sampler import SamplingParams, sample
+from .tokenizer import Tokenizer, load_tokenizer
+
+log = get_logger("engine")
+
+
+@dataclass
+class EngineConfig:
+    model: str = "tiny-test"
+    checkpoint: str = ""
+    tokenizer: str = ""
+    dtype: Any = jnp.bfloat16
+    tp: int = 0                      # 0 = all devices
+    dp: int = 1
+    page_size: int = 16
+    num_pages: int = 2048
+    max_pages_per_seq: int = 320   # 5120 tokens: largest bucket + generation
+    max_batch_size: int = 8
+    prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
+    max_new_tokens_default: int = 1024
+    seed: int = 0
+
+
+@dataclass
+class Sequence:
+    """Host-side state of one in-flight generation."""
+
+    seq_id: int
+    prompt_len: int
+    tokens: list[int] = field(default_factory=list)   # generated tokens
+    params: SamplingParams = field(default_factory=SamplingParams)
+    done: bool = False
+    finish_reason: str = ""        # "stop" | "length" | "preempted"
+    mask_fn: Callable[[list[int]], np.ndarray] | None = None  # constrained decode
+    stream: Callable[[int], None] | None = None
+    ttft_s: float = 0.0
+    started_s: float = field(default_factory=time.perf_counter)
+
+
+class Engine:
+    """Single-process serving engine (thread-safe via one lock: JAX dispatch
+    is serialized per device anyway; the scheduler provides concurrency)."""
+
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        model_cfg: ModelConfig | None = None,
+        params: Any | None = None,
+        tokenizer: Tokenizer | None = None,
+    ):
+        self.cfg = cfg
+        self.model_cfg = model_cfg or get_config_preset(cfg.model)
+        self.tokenizer = tokenizer or load_tokenizer(
+            cfg.tokenizer, vocab_size=self.model_cfg.vocab_size
+        )
+        n_dev = len(jax.devices())
+        tp = cfg.tp if cfg.tp > 0 else max(
+            1, n_dev // cfg.dp if n_dev % cfg.dp == 0 else 1
+        )
+        # kv heads must divide cleanly over tp; fall back gracefully.
+        while tp > 1 and self.model_cfg.num_kv_heads % tp != 0:
+            tp -= 1
+        self.mesh = make_mesh(tp=tp, dp=cfg.dp)
+        self.lock = threading.RLock()
+
+        key = jax.random.PRNGKey(cfg.seed)
+        if params is None:
+            if cfg.checkpoint:
+                from ..models.loader import load_checkpoint
+
+                params = load_checkpoint(cfg.checkpoint, self.model_cfg, cfg.dtype)
+            else:
+                log.warning(
+                    "no checkpoint given: initializing RANDOM weights for %s",
+                    self.model_cfg.name,
+                )
+                params = llama.init_params(self.model_cfg, key, dtype=cfg.dtype)
+        self.params = shard_params(params, llama.param_specs(self.model_cfg), self.mesh)
+        cache = llama.make_cache(
+            self.model_cfg, cfg.num_pages, cfg.page_size, dtype=cfg.dtype
+        )
+        self.cache = shard_params(cache, llama.cache_specs(self.model_cfg), self.mesh)
+        self.alloc = PageAllocator(cfg.num_pages, cfg.page_size, cfg.max_pages_per_seq)
+        self.sequences: dict[int, Sequence] = {}
+        self._sample_key = jax.random.PRNGKey(cfg.seed + 1)
+
+        mc, dt = self.model_cfg, cfg.dtype
+
+        def _prefill(params, tokens, lengths, cache, table):
+            return llama.prefill(params, mc, tokens, lengths, cache, table, dtype=dt)
+
+        def _decode(params, tokens, lengths, cache, table, active):
+            return llama.decode_step(
+                params, mc, tokens, lengths, cache, table, active, dtype=dt
+            )
+
+        self._prefill_jit = jax.jit(_prefill, donate_argnames=("cache",))
+        self._decode_jit = jax.jit(_decode, donate_argnames=("cache",))
+        self._sample_jit = jax.jit(sample)
+
+    # -- bucketing ---------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b:
+                return b
+        from .kvcache import PromptTooLong
+
+        raise PromptTooLong(
+            f"prompt of {n} tokens exceeds the largest prefill bucket "
+            f"{self.cfg.prefill_buckets[-1]}"
+        )
+
+    # -- request lifecycle -------------------------------------------------
+    def add_request(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None = None,
+        mask_fn: Callable[[list[int]], np.ndarray] | None = None,
+        stream: Callable[[int], None] | None = None,
+    ) -> int:
+        """Admit a request: allocate pages, run prefill, sample the first
+        token. Returns the sequence id. Raises OutOfPages when full."""
+        sampling = sampling or SamplingParams()
+        n = len(prompt_ids)
+        if n == 0:
+            raise ValueError("empty prompt")
+        with self.lock:
+            perf = get_perf_stats()
+            t0 = time.perf_counter()
+            bucket = self._bucket(n)  # raises PromptTooLong BEFORE allocating
+            seq_id = self.alloc.allocate(n)
+            seq = Sequence(seq_id, n, params=sampling, mask_fn=mask_fn, stream=stream)
+            self.sequences[seq_id] = seq
+            tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+            tokens[0, :n] = prompt_ids
+            table = self.alloc.page_table_row(seq_id)[None, :]
+            with self.mesh:
+                logits, self.cache = self._prefill_jit(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray([n], jnp.int32),
+                    self.cache,
+                    jnp.asarray(table),
+                )
+            token = int(self._sample_one(logits, [seq])[0])
+            seq.ttft_s = time.perf_counter() - t0
+            perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
+            perf.record_metric("engine.prefill_tokens", n, "tok")
+            self._accept_token(seq, token)
+            return seq_id
+
+    def _sample_one(self, logits: jax.Array, seqs: list[Sequence]) -> np.ndarray:
+        B = logits.shape[0]
+        temps = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        mask = None
+        for i, s in enumerate(seqs):
+            if s is None:
+                continue
+            temps[i] = s.params.temperature
+            top_k[i] = s.params.top_k
+            top_p[i] = s.params.top_p
+            if s.mask_fn is not None:
+                if mask is None:
+                    mask = np.ones((B, self.model_cfg.vocab_size), bool)
+                mask[i] = s.mask_fn(s.tokens)
+        self._sample_key, sub = jax.random.split(self._sample_key)
+        tok = self._sample_jit(
+            logits,
+            sub,
+            jnp.asarray(temps),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            None if mask is None else jnp.asarray(mask),
+        )
+        return np.asarray(tok)
+
+    def _accept_token(self, seq: Sequence, token: int) -> None:
+        seq.tokens.append(token)
+        if seq.stream is not None:
+            seq.stream(token)
+        if token == self.tokenizer.eos_id:
+            seq.done = True
+            seq.finish_reason = "stop"
+        elif len(seq.tokens) >= seq.params.max_tokens:
+            seq.done = True
+            seq.finish_reason = "length"
+        elif seq.params.stop and self._hit_stop_string(seq):
+            seq.done = True
+            seq.finish_reason = "stop"
+
+    def _hit_stop_string(self, seq: Sequence) -> bool:
+        """Check the decoded tail for any stop string, so generation halts at
+        the stop instead of burning decode steps to max_tokens."""
+        longest = max(len(s) for s in seq.params.stop)
+        tail_tokens = seq.tokens[-(longest + 8) :]
+        tail = self.tokenizer.decode(tail_tokens)
+        return any(s in tail for s in seq.params.stop)
+
+    def step(self, seq_ids: list[int] | None = None) -> dict[int, int]:
+        """One decode step over up to max_batch_size running sequences.
+        Returns {seq_id: new_token} for sequences that advanced."""
+        with self.lock:
+            running = [
+                s for s in self.sequences.values() if not s.done
+            ] if seq_ids is None else [
+                self.sequences[i] for i in seq_ids if not self.sequences[i].done
+            ]
+            running = running[: self.cfg.max_batch_size]
+            if not running:
+                return {}
+            B = self.cfg.max_batch_size
+            # Account for the token each sequence is about to write. A
+            # sequence that cannot grow (pool exhausted or per-seq page cap)
+            # is finished as truncated instead of killing the whole step.
+            grown: list[Sequence] = []
+            for s in running:
+                try:
+                    self.alloc.extend(s.seq_id, 1)
+                    grown.append(s)
+                except OutOfPages:
+                    s.done = True
+                    s.finish_reason = "length"
+                    log.warning(
+                        "seq %d truncated: KV page budget exhausted", s.seq_id
+                    )
+            running = grown
+            if not running:
+                return {}
+            ids: list[int | None] = [s.seq_id for s in running]
+            ids += [None] * (B - len(ids))
+            table, lengths, active = self.alloc.batch_views(ids, B)
+            # lengths now include the new token; decode wants the write
+            # offset (tokens already present before this step).
+            write_at = lengths.copy()
+            for i, s in enumerate(running):
+                write_at[i] = lengths[i] - 1
+            tokens = np.zeros((B,), np.int32)
+            for i, s in enumerate(running):
+                tokens[i] = s.tokens[-1] if s.tokens else self.tokenizer.bos_id
+            with self.mesh:
+                logits, self.cache = self._decode_jit(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray(write_at),
+                    self.cache,
+                    jnp.asarray(table),
+                    jnp.asarray(active),
+                )
+            sampled = self._sample_one(logits, running + [None] * (B - len(running)))
+            out: dict[int, int] = {}
+            for i, s in enumerate(running):
+                tok = int(sampled[i])
+                self._accept_token(s, tok)
+                out[s.seq_id] = tok
+            get_perf_stats().record_metric("engine.decode_tokens", len(running), "tok")
+            return out
+
+    def finish(self, seq_id: int) -> list[int]:
+        """Release resources; returns the generated tokens."""
+        with self.lock:
+            seq = self.sequences.pop(seq_id)
+            self.alloc.free(seq_id)
+            return seq.tokens
+
+    # -- convenience (tests / bench) ----------------------------------------
+    def generate(
+        self,
+        prompts: list[list[int]],
+        sampling: SamplingParams | None = None,
+    ) -> list[list[int]]:
+        """Synchronous batch generation with continuous decode stepping."""
+        ids = [self.add_request(p, sampling) for p in prompts]
+        pending = {i for i in ids if not self.sequences[i].done}
+        while pending:
+            self.step(sorted(pending))
+            pending = {i for i in pending if not self.sequences[i].done}
+        return [self.finish(i) for i in ids]

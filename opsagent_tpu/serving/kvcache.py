@@ -1,0 +1,116 @@
+"""Host-side paged KV-cache bookkeeping.
+
+The device holds the pages (``models.llama.make_cache``); this module owns
+the free list and per-sequence page tables. Allocation is O(pages) with a
+simple free list — the page count is small (thousands) and allocation happens
+once per admitted request plus on page-boundary crossings during decode.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class OutOfPages(Exception):
+    """No free KV pages right now; the scheduler should queue the request."""
+
+
+class PromptTooLong(Exception):
+    """The request can NEVER be admitted (exceeds max_pages_per_seq or the
+    largest prefill bucket); fail fast instead of queueing."""
+
+
+@dataclass
+class SeqAlloc:
+    seq_id: int
+    pages: list[int] = field(default_factory=list)
+    length: int = 0  # tokens currently in cache
+
+
+class PageAllocator:
+    def __init__(self, num_pages: int, page_size: int, max_pages_per_seq: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        self._seqs: dict[int, SeqAlloc] = {}
+        self._next_id = 0
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.page_size)
+
+    def can_admit(self, num_tokens: int) -> bool:
+        return self.pages_needed(num_tokens) <= len(self._free)
+
+    def length(self, seq_id: int) -> int:
+        return self._seqs[seq_id].length
+
+    # -- lifecycle ---------------------------------------------------------
+    def allocate(self, num_tokens: int) -> int:
+        """Allocate pages for a new sequence of ``num_tokens``; returns seq_id."""
+        need = self.pages_needed(max(1, num_tokens))
+        if need > self.max_pages_per_seq:
+            raise PromptTooLong(
+                f"sequence needs {need} pages > max_pages_per_seq="
+                f"{self.max_pages_per_seq} "
+                f"({self.max_pages_per_seq * self.page_size} tokens)"
+            )
+        if need > len(self._free):
+            raise OutOfPages(f"need {need} pages, {len(self._free)} free")
+        seq = SeqAlloc(self._next_id)
+        self._next_id += 1
+        for _ in range(need):
+            seq.pages.append(self._free.pop())
+        seq.length = num_tokens
+        self._seqs[seq.seq_id] = seq
+        return seq.seq_id
+
+    def extend(self, seq_id: int, new_tokens: int = 1) -> None:
+        """Account for appended tokens, growing by a page when crossing a
+        boundary. Raises OutOfPages when the pool is exhausted (caller may
+        preempt another sequence and retry)."""
+        seq = self._seqs[seq_id]
+        target = seq.length + new_tokens
+        while len(seq.pages) * self.page_size < target:
+            if not self._free:
+                raise OutOfPages(f"seq {seq_id} needs a page, none free")
+            if len(seq.pages) >= self.max_pages_per_seq:
+                raise OutOfPages(f"seq {seq_id} hit max_pages_per_seq")
+            seq.pages.append(self._free.pop())
+        seq.length = target
+
+    def free(self, seq_id: int) -> None:
+        seq = self._seqs.pop(seq_id, None)
+        if seq is not None:
+            self._free.extend(seq.pages)
+
+    # -- device views ------------------------------------------------------
+    def page_table_row(self, seq_id: int) -> np.ndarray:
+        """This sequence's page table padded to max_pages_per_seq with -1."""
+        row = np.full((self.max_pages_per_seq,), -1, np.int32)
+        pages = self._seqs[seq_id].pages
+        row[: len(pages)] = pages
+        return row
+
+    def batch_views(
+        self, seq_ids: list[int], batch_size: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(page_table [batch, MaxP], lengths [batch], active [batch]) for a
+        decode batch; unused slots are inactive with empty tables."""
+        table = np.full((batch_size, self.max_pages_per_seq), -1, np.int32)
+        lengths = np.zeros((batch_size,), np.int32)
+        active = np.zeros((batch_size,), bool)
+        for i, sid in enumerate(seq_ids):
+            if sid is None:
+                continue
+            table[i] = self.page_table_row(sid)
+            lengths[i] = self._seqs[sid].length
+            active[i] = True
+        return table, lengths, active

@@ -1,0 +1,60 @@
+"""Token sampling: greedy, temperature, top-k, top-p — one fused jittable
+function over the decode batch, with an optional constrained-decoding mask.
+
+The near-greedy default mirrors the reference client's
+``Temperature: math.SmallestNonzeroFloat32`` (reference pkg/llms/openai.go:73):
+temperature 0 means argmax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0      # 1.0 = disabled
+    max_tokens: int = 2048
+    stop: tuple[str, ...] = ()
+
+
+def sample(
+    logits: jax.Array,             # [B, V] float32
+    key: jax.Array,
+    temperature: jax.Array,        # [B]
+    top_k: jax.Array,              # [B] int32 (0 = off)
+    top_p: jax.Array,              # [B] float32 (1.0 = off)
+    allowed_mask: jax.Array | None = None,  # [B, V] bool; False = forbidden
+) -> jax.Array:
+    """Sample one token per row. Rows with temperature<=0 take the argmax."""
+    B, V = logits.shape
+    if allowed_mask is not None:
+        logits = jnp.where(allowed_mask, logits, NEG_INF)
+
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # -- top-k: mask everything below the k-th largest logit.
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]          # [B, V]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, V) - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)  # [B, 1]
+    logits_k = jnp.where(logits >= kth, logits, NEG_INF)
+
+    # -- top-p over the surviving set.
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    probs_sorted = jax.nn.softmax(jnp.sort(logits_k / t, axis=-1)[:, ::-1], axis=-1)
+    cumsum = jnp.cumsum(probs_sorted, axis=-1)
+    # Number of tokens needed to reach top_p mass (always keep >= 1).
+    keep_sorted = cumsum - probs_sorted < top_p[:, None]
+    cutoff_val = jnp.sort(logits_k, axis=-1)[:, ::-1]
+    cutoff = jnp.max(jnp.where(keep_sorted, -cutoff_val, NEG_INF), axis=-1)
+    logits_p = jnp.where(logits_k >= -cutoff[:, None], logits_k, NEG_INF)
+
+    sampled = jax.random.categorical(key, logits_p / t, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -1,0 +1,185 @@
+"""Continuous-batching scheduler.
+
+Maps concurrent agent sessions onto the engine's fixed decode batch
+(BASELINE.json config 5: 32 concurrent execute sessions): an admission queue
+feeds prefill as pages free up; all running sequences advance together in
+decode steps; finished sequences release pages immediately, letting queued
+requests enter mid-flight. Runs in a dedicated thread — JAX dispatch is
+blocking — with asyncio-friendly completion events.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from ..utils.logger import get_logger
+from ..utils.perf import get_perf_stats
+from .engine import Engine
+from .kvcache import OutOfPages, PromptTooLong
+from .sampler import SamplingParams
+
+log = get_logger("scheduler")
+
+
+@dataclass
+class Request:
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    mask_fn: Callable[[list[int]], np.ndarray] | None = None
+    on_token: Callable[[int], None] | None = None
+    # filled by the scheduler:
+    seq_id: int | None = None
+    tokens: list[int] = field(default_factory=list)
+    finish_reason: str = ""
+    error: str = ""
+    done = None  # threading.Event, set in __post_init__
+    enqueued_s: float = field(default_factory=time.perf_counter)
+
+    def __post_init__(self) -> None:
+        self.done = threading.Event()
+
+
+class Scheduler:
+    def __init__(self, engine: Engine, admission_timeout_s: float = 120.0):
+        self.engine = engine
+        self.admission_timeout_s = admission_timeout_s
+        self._queue: "queue.Queue[Request]" = queue.Queue()
+        self._waiting: list[Request] = []
+        self._running: dict[int, Request] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+
+    # -- public ------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def submit(self, req: Request) -> Request:
+        self._queue.put(req)
+        self._wake.set()
+        return req
+
+    def complete(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        mask_fn=None,
+        on_token=None,
+        timeout_s: float = 600.0,
+    ) -> list[int]:
+        """Blocking convenience: submit and wait for the generated tokens."""
+        req = Request(prompt_ids, sampling, mask_fn=mask_fn, on_token=on_token)
+        self.submit(req)
+        if not req.done.wait(timeout_s):
+            raise TimeoutError("generation timed out")
+        if req.error:
+            raise RuntimeError(req.error)
+        return req.tokens
+
+    # -- loop --------------------------------------------------------------
+    def _drain_queue(self) -> None:
+        while True:
+            try:
+                self._waiting.append(self._queue.get_nowait())
+            except queue.Empty:
+                return
+
+    def _try_admit(self) -> None:
+        """Admit waiting requests while page budget and batch slots allow."""
+        still: list[Request] = []
+        now = time.perf_counter()
+        for req in self._waiting:
+            if len(self._running) >= self.engine.cfg.max_batch_size:
+                still.append(req)
+                continue
+            if now - req.enqueued_s > self.admission_timeout_s:
+                req.error = "admission timed out (engine saturated)"
+                req.done.set()
+                continue
+            try:
+                seq_id = self.engine.add_request(
+                    req.prompt_ids,
+                    req.sampling,
+                    mask_fn=req.mask_fn,
+                    stream=req.on_token,
+                )
+            except OutOfPages:
+                # Transient: pages will free as running sequences finish.
+                still.append(req)
+                continue
+            except PromptTooLong as e:
+                # Permanent: reject immediately with a clear error.
+                req.error = str(e)
+                req.done.set()
+                continue
+            except Exception as e:  # noqa: BLE001 - surfaced on the request
+                req.error = f"admission failed: {e}"
+                req.done.set()
+                continue
+            req.seq_id = seq_id
+            self._running[seq_id] = req
+            get_perf_stats().record_metric(
+                "scheduler.queue_wait", (now - req.enqueued_s) * 1e3, "ms"
+            )
+        self._waiting = still
+
+    def _reap(self) -> None:
+        finished = [
+            sid for sid, req in self._running.items()
+            if self.engine.sequences[sid].done
+        ]
+        for sid in finished:
+            req = self._running.pop(sid)
+            req.finish_reason = self.engine.sequences[sid].finish_reason
+            req.tokens = self.engine.finish(sid)
+            req.done.set()
+
+    def _loop(self) -> None:
+        log.info("scheduler loop started (batch=%d)", self.engine.cfg.max_batch_size)
+        while not self._stop.is_set():
+            try:
+                self._drain_queue()
+                self._try_admit()
+                self._reap()
+                if not self._running:
+                    # idle: wait for work
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                self.engine.step(sorted(self._running))
+                self._reap()
+            except Exception as e:  # noqa: BLE001 - the loop must survive
+                log.exception("scheduler step failed; failing in-flight requests")
+                for sid, req in list(self._running.items()):
+                    req.error = f"engine step failed: {e}"
+                    try:
+                        req.tokens = self.engine.finish(sid)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    req.done.set()
+                self._running.clear()
+        # drain on shutdown
+        for req in self._waiting:
+            req.error = "scheduler stopped"
+            req.done.set()
+        for sid, req in list(self._running.items()):
+            req.tokens = self.engine.finish(sid)
+            req.error = "scheduler stopped"
+            req.done.set()
+        self._running.clear()
+        log.info("scheduler loop stopped")

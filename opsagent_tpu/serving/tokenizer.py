@@ -1,0 +1,88 @@
+"""Tokenizers for the serving engine.
+
+Two implementations behind one interface:
+
+- ``ByteTokenizer``: hermetic UTF-8 byte-level tokenizer (vocab 256 + special
+  tokens). Used by tests and random-weight benchmarks — no downloaded
+  artifacts, fully deterministic.
+- ``HFTokenizer``: wraps a local HuggingFace tokenizer directory for real
+  checkpoints (Llama-3 / Qwen2.5 / DeepSeek vocabularies).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+    def token_str(self, token_id: int) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + specials. ids 0..255 = bytes; 256=PAD, 257=BOS, 258=EOS,
+    259..262 = chat-structure markers."""
+
+    PAD, BOS, EOS = 256, 257, 258
+    SYS, USER, ASSISTANT, END = 259, 260, 261, 262
+
+    def __init__(self, vocab_size: int = 512):
+        assert vocab_size >= 263
+        self.vocab_size = vocab_size
+        self.pad_id = self.PAD
+        self.bos_id = self.BOS
+        self.eos_id = self.EOS
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def token_str(self, token_id: int) -> str:
+        if 0 <= token_id < 256:
+            return chr(token_id) if token_id < 128 else ""
+        return ""
+
+
+class HFTokenizer:
+    """Local HuggingFace tokenizer wrapper (no network access)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.bos_id = self._tok.bos_token_id or 0
+        self.eos_id = self._tok.eos_token_id or 0
+        self.pad_id = (
+            self._tok.pad_token_id
+            if self._tok.pad_token_id is not None
+            else self.eos_id
+        )
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def token_str(self, token_id: int) -> str:
+        return self._tok.convert_ids_to_tokens(token_id) or ""
+
+    @property
+    def hf(self):  # escape hatch for chat templates
+        return self._tok
+
+
+def load_tokenizer(path: str = "", vocab_size: int = 512) -> Tokenizer:
+    if path:
+        return HFTokenizer(path)
+    return ByteTokenizer(vocab_size=vocab_size)

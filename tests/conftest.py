@@ -7,12 +7,21 @@ sharding without a real pod slice; SURVEY.md section 4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the virtual 8-device CPU mesh. Env vars alone are NOT enough here:
+# a TPU-plugin sitecustomize may import jax at interpreter boot (before this
+# conftest), freezing jax_platforms from the image environment — so set the
+# XLA flag env (read lazily at CPU-client creation) AND override the already-
+# imported config.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

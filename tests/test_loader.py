@@ -1,0 +1,64 @@
+"""Checkpoint loader roundtrip tests (HF safetensors naming)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.models import llama
+from opsagent_tpu.models.config import TINY_TEST, ModelConfig
+from opsagent_tpu.models.loader import (
+    CheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def test_save_load_roundtrip(tmp_path):
+    params = llama.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    ckpt = tmp_path / "model.safetensors"
+    save_checkpoint(str(ckpt), params)
+    loaded = load_checkpoint(str(ckpt), TINY_TEST, dtype=jnp.float32)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6),
+        params,
+        loaded,
+    )
+    # Loaded weights must produce identical logits.
+    tokens = jnp.array([[1, 2, 3, 4]], jnp.int32)
+    l1 = llama.forward_full(params, TINY_TEST, tokens, dtype=jnp.float32)
+    l2 = llama.forward_full(loaded, TINY_TEST, tokens, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+
+def test_load_with_attn_bias(tmp_path):
+    cfg = ModelConfig(
+        name="tiny-qwen", vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, attn_bias=True,
+        rope_theta=10000.0,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    ckpt = tmp_path / "model.safetensors"
+    save_checkpoint(str(ckpt), params)
+    loaded = load_checkpoint(str(ckpt), cfg, dtype=jnp.float32)
+    assert "bq" in loaded["layers"]
+    np.testing.assert_allclose(
+        np.asarray(loaded["layers"]["bq"]), np.asarray(params["layers"]["bq"]), atol=1e-6
+    )
+
+
+def test_shape_mismatch_rejected(tmp_path):
+    params = llama.init_params(TINY_TEST, jax.random.PRNGKey(0), dtype=jnp.float32)
+    ckpt = tmp_path / "model.safetensors"
+    save_checkpoint(str(ckpt), params)
+    wrong = ModelConfig(
+        name="wrong", vocab_size=1024, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2,
+    )
+    with pytest.raises(CheckpointError, match="does not match"):
+        load_checkpoint(str(ckpt), wrong, dtype=jnp.float32)
+
+
+def test_missing_dir(tmp_path):
+    with pytest.raises((CheckpointError, FileNotFoundError)):
+        load_checkpoint(str(tmp_path / "nope"), TINY_TEST)

@@ -1,0 +1,195 @@
+"""Serving-engine core tests (CPU, f32, tiny model): attention ops, paged KV,
+prefill/decode equivalence, tensor-parallel sharding equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.models.config import TINY_TEST
+from opsagent_tpu.models import llama
+from opsagent_tpu.ops.attention import (
+    causal_prefill_attention,
+    paged_decode_attention,
+    write_kv_pages,
+)
+from opsagent_tpu.parallel.mesh import make_mesh, shard_params, spec_tree_shardings
+
+CFG = TINY_TEST
+DTYPE = jnp.float32
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0), dtype=DTYPE)
+
+
+def naive_attention(q, k, v, lengths=None):
+    """Straightforward GQA reference: repeat kv heads, causal mask."""
+    B, S, H, D = q.shape
+    K = k.shape[2]
+    k = jnp.repeat(k, H // K, axis=2)
+    v = jnp.repeat(v, H // K, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k) / (D ** 0.5)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    if lengths is not None:
+        mask = mask[None, None] & (jnp.arange(S)[None, :] < lengths[:, None])[:, None, None, :]
+    else:
+        mask = mask[None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    return jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(scores, -1), v)
+
+
+def test_causal_attention_matches_naive():
+    key = jax.random.PRNGKey(1)
+    B, S, H, K, D = 2, 10, 4, 2, 8
+    q, k, v = (
+        jax.random.normal(kk, (B, S, h, D))
+        for kk, h in zip(jax.random.split(key, 3), (H, K, K))
+    )
+    lengths = jnp.array([10, 7])
+    got = causal_prefill_attention(q, k, v, lengths)
+    want = naive_attention(q, k, v, lengths)
+    np.testing.assert_allclose(got[0], want[0], atol=1e-5)
+    np.testing.assert_allclose(got[1, :7], want[1, :7], atol=1e-5)
+
+
+def test_write_and_paged_decode_matches_contiguous():
+    key = jax.random.PRNGKey(2)
+    N, P, K, D, H = 8, 4, 2, 8, 4
+    B = 2
+    lens = [9, 5]
+    k_pages = jnp.zeros((N, P, K, D))
+    v_pages = jnp.zeros((N, P, K, D))
+    # seq0 gets pages [3, 0, 5], seq1 gets [1, 6]
+    table = jnp.array([[3, 0, 5, -1], [1, 6, -1, -1]], jnp.int32)
+    kf = jax.random.normal(key, (B, 12, K, D))
+    vf = jax.random.normal(jax.random.PRNGKey(3), (B, 12, K, D))
+    k_pages, v_pages = write_kv_pages(
+        k_pages, v_pages, kf, vf, table, jnp.zeros((B,), jnp.int32),
+        valid_len=jnp.array(lens),
+    )
+    q = jax.random.normal(jax.random.PRNGKey(4), (B, H, D))
+    got = paged_decode_attention(q, k_pages, v_pages, table, jnp.array(lens))
+    for b, ln in enumerate(lens):
+        kr = jnp.repeat(kf[b, :ln], H // K, axis=1)  # [ln, H, D]
+        vr = jnp.repeat(vf[b, :ln], H // K, axis=1)
+        scores = jnp.einsum("hd,thd->ht", q[b], kr) / (D ** 0.5)
+        probs = jax.nn.softmax(scores, axis=-1)
+        want = jnp.einsum("ht,thd->hd", probs, vr)
+        np.testing.assert_allclose(got[b], want, atol=1e-5)
+
+
+def test_write_kv_pages_drops_invalid():
+    N, P, K, D = 2, 2, 1, 2
+    k_pages = jnp.zeros((N, P, K, D))
+    v_pages = jnp.zeros((N, P, K, D))
+    table = jnp.array([[0, -1]], jnp.int32)
+    k_new = jnp.ones((1, 4, K, D))
+    k2, v2 = write_kv_pages(
+        k_pages, v_pages, k_new, k_new, table, jnp.zeros((1,), jnp.int32),
+        valid_len=jnp.array([2]),
+    )
+    # Only the first page's 2 slots were written.
+    assert float(k2[0].sum()) == 2 * K * D
+    assert float(k2[1].sum()) == 0.0
+
+
+def test_prefill_matches_forward_full(params):
+    B, S = 2, 12
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (B, S), 0, CFG.vocab_size)
+    lengths = jnp.array([12, 8])
+    cache = llama.make_cache(CFG, num_pages=16, page_size=4, dtype=DTYPE)
+    table = jnp.array(
+        [[0, 1, 2, -1, -1], [3, 4, -1, -1, -1]], jnp.int32
+    )
+    logits, cache = llama.prefill(params, CFG, tokens, lengths, cache, table, dtype=DTYPE)
+    full = llama.forward_full(params, CFG, tokens, dtype=DTYPE)
+    np.testing.assert_allclose(logits[0], full[0, 11], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(logits[1], full[1, 7], rtol=2e-4, atol=2e-4)
+
+
+def test_decode_chain_matches_forward_full(params):
+    """Prefill a prompt, then teacher-force decode steps; every step's logits
+    must match the all-at-once causal forward."""
+    S_total, S_prompt = 10, 4
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (1, S_total), 0, CFG.vocab_size)
+    full = llama.forward_full(params, CFG, tokens, dtype=DTYPE)  # [1, S, V]
+
+    cache = llama.make_cache(CFG, num_pages=8, page_size=4, dtype=DTYPE)
+    table = jnp.array([[2, 5, 7]], jnp.int32)
+    lengths = jnp.array([S_prompt])
+    logits, cache = llama.prefill(
+        params, CFG, tokens[:, :S_prompt], lengths, cache, table, dtype=DTYPE
+    )
+    np.testing.assert_allclose(logits[0], full[0, S_prompt - 1], rtol=2e-4, atol=2e-4)
+
+    for t in range(S_prompt, S_total):
+        logits, cache = llama.decode_step(
+            params,
+            CFG,
+            tokens[:, t],
+            jnp.array([t]),
+            cache,
+            table,
+            active=jnp.array([True]),
+            dtype=DTYPE,
+        )
+        np.testing.assert_allclose(
+            logits[0], full[0, t], rtol=3e-4, atol=3e-4,
+            err_msg=f"decode step at position {t}",
+        )
+
+
+def test_inactive_slot_does_not_corrupt(params):
+    """A padded decode slot (active=False) must not write to pages."""
+    cache = llama.make_cache(CFG, num_pages=4, page_size=4, dtype=DTYPE)
+    table = jnp.array([[0, -1], [1, -1]], jnp.int32)
+    tokens = jnp.array([3, 7])
+    logits, cache2 = llama.decode_step(
+        params, CFG, tokens, jnp.array([0, 0]), cache, table,
+        active=jnp.array([True, False]), dtype=DTYPE,
+    )
+    # Page 1 (the inactive slot's page) stays zero.
+    assert float(jnp.abs(cache2["k"][:, 1]).sum()) == 0.0
+    assert float(jnp.abs(cache2["k"][:, 0]).sum()) > 0.0
+
+
+def test_tp_sharded_prefill_matches_single_device(params):
+    """dp=4 x tp=2 over the virtual CPU mesh must be numerically equivalent
+    (tiny-test has 2 kv heads, so tp=2 is the max clean kv shard)."""
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    mesh = make_mesh(tp=2, dp=4)
+    specs = llama.param_specs(CFG)
+    sharded = shard_params(params, specs, mesh)
+    cache = llama.make_cache(CFG, num_pages=8, page_size=4, dtype=DTYPE)
+    cache_sharded = shard_params(cache, llama.cache_specs(CFG), mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0, CFG.vocab_size)
+    lengths = jnp.array([8, 6])
+    table = jnp.array([[0, 1, -1], [2, 3, -1]], jnp.int32)
+
+    ref_logits, _ = llama.prefill(params, CFG, tokens, lengths, cache, table, dtype=DTYPE)
+
+    @jax.jit
+    def run(p, c):
+        return llama.prefill(p, CFG, tokens, lengths, c, table, dtype=DTYPE)
+
+    with mesh:
+        tp_logits, tp_cache = run(sharded, cache_sharded)
+    np.testing.assert_allclose(np.asarray(tp_logits), np.asarray(ref_logits), rtol=2e-3, atol=2e-3)
+
+
+def test_qwen_style_attn_bias():
+    from opsagent_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(
+        name="tiny-qwen", vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, attn_bias=True,
+        rope_theta=10000.0,
+    )
+    p = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=DTYPE)
+    assert "bq" in p["layers"]
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    logits = llama.forward_full(p, cfg, tokens, dtype=DTYPE)
+    assert logits.shape == (1, 4, 128)
+    assert bool(jnp.isfinite(logits).all())
