@@ -39,6 +39,11 @@ class LLMError(Exception):
 Provider = Callable[[dict[str, Any]], dict[str, Any]]
 _provider_factories: dict[str, Callable[[str], Provider]] = {}
 
+# Schemes whose registration lives in a module imported on first use, so the
+# agent CLI paths (which never import the serving stack up front) still
+# resolve --model tpu://<name> without paying the JAX import cost otherwise.
+_LAZY_SCHEME_MODULES = {"tpu": "opsagent_tpu.serving.api"}
+
 
 def register_provider(scheme: str, factory: Callable[[str], Provider]) -> None:
     """Register a provider factory for a model/baseURL scheme (e.g. "tpu").
@@ -132,6 +137,11 @@ class ChatClient:
         if scheme is not None:
             name, target = scheme
             factory = _provider_factories.get(name)
+            if factory is None and name in _LAZY_SCHEME_MODULES:
+                import importlib
+
+                importlib.import_module(_LAZY_SCHEME_MODULES[name])
+                factory = _provider_factories.get(name)
             if factory is None:
                 raise LLMError(f"no provider registered for scheme {name}://")
             body["model"] = target or model

@@ -247,11 +247,16 @@ def _lm_head(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
 
 
 def forward_full(
-    params: Params, cfg: ModelConfig, tokens: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,
+    dtype: jnp.dtype = jnp.bfloat16,
+    remat: bool = False,
 ) -> jax.Array:
     """All-positions logits [B, S, V] with vanilla causal attention and no
     cache — the ground-truth oracle for prefill/decode equivalence tests and
-    the loss path for the training step."""
+    the loss path for the training step. ``remat=True`` checkpoints the
+    scanned layer body (recompute activations in backward: HBM for FLOPs)."""
     B, S = tokens.shape
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
@@ -268,6 +273,8 @@ def forward_full(
         x = x + _mlp(h, lp)
         return x, None
 
+    if remat:
+        body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return _lm_head(params, cfg, x)

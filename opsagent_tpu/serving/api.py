@@ -28,7 +28,7 @@ from ..utils.logger import get_logger
 from .chat_template import apply_chat_template
 from .engine import Engine, EngineConfig
 from .sampler import SamplingParams
-from .scheduler import Request, Scheduler
+from .scheduler import Request, RequestError, Scheduler
 
 log = get_logger("serving.api")
 
@@ -119,7 +119,7 @@ class ServingStack:
         if not req.done.wait(600):
             raise TimeoutError("generation timed out")
         if req.error:
-            raise RuntimeError(req.error)
+            raise RequestError(req.error, req.error_status)
         tokens = req.tokens
         text, finish = self._finalize_text(tokens, sampling.stop, req.finish_reason)
         tool_calls = self._parse_tool_calls(text)
@@ -173,11 +173,17 @@ class ServingStack:
             target=lambda: (req.done.wait(600), token_q.put(None)), daemon=True
         )
         watchdog.start()
-        # Incremental UTF-8-safe decoding: decode the cumulative token list
-        # and emit only the new suffix, withholding trailing bytes that do
-        # not yet form a complete character (multi-byte chars can span
-        # tokens with byte-level vocabularies).
-        emitted = ""
+        # Incremental detokenization with a SLIDING window (vLLM-style):
+        # decode only tokens[prefix_off:] and diff against the same window's
+        # previous decode, so per-token cost is O(window), not O(total).
+        # Withhold a trailing "�" (incomplete multi-byte char) and hold back
+        # max_stop-1 chars so a stop string straddling a chunk boundary is
+        # still caught before emission.
+        decode = self.engine.tokenizer.decode
+        max_stop = max((len(s) for s in sampling.stop), default=0)
+        prefix_off = 0   # window start
+        read_off = 0     # tokens already diffed within the window
+        pending = ""     # decoded but unemitted (stop-string holdback)
         stopped = False
         while True:
             tok = token_q.get()
@@ -186,22 +192,47 @@ class ServingStack:
             if tok == eos or stopped:
                 continue
             sent.append(tok)
-            text = self.engine.tokenizer.decode(sent)
-            if text.endswith("�"):
+            prefix_text = decode(sent[prefix_off:read_off])
+            window_text = decode(sent[prefix_off:])
+            if window_text.endswith("�"):
                 continue  # incomplete multi-byte tail; wait for more tokens
+            # Both decodes start at prefix_off, so context-dependent effects
+            # at the window start (sentencepiece leading-space stripping)
+            # cancel in the diff and the windows telescope correctly. Guard
+            # against decoders whose cleanup makes prefix_text not a literal
+            # prefix of window_text by cutting at the common prefix instead
+            # of blindly at len(prefix_text).
+            cut = len(prefix_text)
+            if window_text[:cut] != prefix_text:
+                cut = 0
+                for a, b in zip(prefix_text, window_text):
+                    if a != b:
+                        break
+                    cut += 1
+            delta = window_text[cut:]
+            prefix_off, read_off = read_off, len(sent)
+            if not delta:
+                continue
+            pending += delta
             for s in sampling.stop:
-                idx = text.find(s)
+                idx = pending.find(s)
                 if idx >= 0:
-                    text = text[:idx]
+                    pending = pending[:idx]
                     stopped = True
                     break
-            delta = text[len(emitted) :]
-            if delta:
-                yield chunk({"content": delta})
-                emitted = text
+            if stopped:
+                emit, pending = pending, ""
+            elif max_stop > 1:
+                emit, pending = pending[: -(max_stop - 1)], pending[-(max_stop - 1):]
+            else:
+                emit, pending = pending, ""
+            if emit:
+                yield chunk({"content": emit})
         if req.error:
             yield {"error": {"message": req.error}}
             return
+        if not stopped and pending:
+            yield chunk({"content": pending})
         finish = "stop" if stopped else (req.finish_reason or "length")
         yield chunk({}, finish=finish)
 
@@ -290,6 +321,18 @@ def build_engine_app(stack: ServingStack):
             )
         loop = asyncio.get_running_loop()
         if body.get("stream"):
+            gen = stack.chat_completion_stream(body)
+            # Pull the first chunk BEFORE preparing the stream: request-
+            # translation errors (bad sampling params, prompt too long)
+            # surface as a proper JSON error status, not a dead connection.
+            try:
+                first = await loop.run_in_executor(None, lambda: next(gen, None))
+            except Exception as e:  # noqa: BLE001
+                status = e.status if isinstance(e, RequestError) else 500
+                return web.json_response(
+                    {"error": {"message": str(e), "type": type(e).__name__}},
+                    status=status,
+                )
             resp = web.StreamResponse(
                 headers={
                     "Content-Type": "text/event-stream",
@@ -297,13 +340,20 @@ def build_engine_app(stack: ServingStack):
                 }
             )
             await resp.prepare(request)
-            gen = stack.chat_completion_stream(body)
-            while True:
-                chunk = await loop.run_in_executor(None, lambda: next(gen, None))
-                if chunk is None:
-                    break
+            chunk = first
+            try:
+                while chunk is not None:
+                    await resp.write(
+                        b"data: " + json.dumps(chunk).encode("utf-8") + b"\n\n"
+                    )
+                    chunk = await loop.run_in_executor(
+                        None, lambda: next(gen, None)
+                    )
+            except Exception as e:  # noqa: BLE001 - headers already sent
+                log.exception("stream failed mid-flight")
+                err = {"error": {"message": str(e), "type": type(e).__name__}}
                 await resp.write(
-                    b"data: " + json.dumps(chunk).encode("utf-8") + b"\n\n"
+                    b"data: " + json.dumps(err).encode("utf-8") + b"\n\n"
                 )
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
@@ -311,7 +361,7 @@ def build_engine_app(stack: ServingStack):
         try:
             out = await loop.run_in_executor(None, stack.chat_completion, body)
         except Exception as e:  # noqa: BLE001 - OpenAI-style error envelope
-            status = 400 if "prompt" in str(e).lower() else 500
+            status = e.status if isinstance(e, RequestError) else 500
             return web.json_response(
                 {"error": {"message": str(e), "type": type(e).__name__}},
                 status=status,

@@ -31,7 +31,7 @@ from ..models.config import ModelConfig, get_config_preset
 from ..parallel.mesh import make_mesh, shard_params
 from ..utils.logger import get_logger
 from ..utils.perf import get_perf_stats
-from .kvcache import PageAllocator, OutOfPages
+from .kvcache import InvalidRequest, PageAllocator, OutOfPages
 from .sampler import SamplingParams, sample
 from .tokenizer import Tokenizer, load_tokenizer
 
@@ -157,26 +157,36 @@ class Engine:
         sampling = sampling or SamplingParams()
         n = len(prompt_ids)
         if n == 0:
-            raise ValueError("empty prompt")
+            raise InvalidRequest("empty prompt")
         with self.lock:
             perf = get_perf_stats()
             t0 = time.perf_counter()
             bucket = self._bucket(n)  # raises PromptTooLong BEFORE allocating
             seq_id = self.alloc.allocate(n)
-            seq = Sequence(seq_id, n, params=sampling, mask_fn=mask_fn, stream=stream)
-            self.sequences[seq_id] = seq
-            tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-            tokens[0, :n] = prompt_ids
-            table = self.alloc.page_table_row(seq_id)[None, :]
-            with self.mesh:
-                logits, self.cache = self._prefill_jit(
-                    self.params,
-                    jnp.asarray(tokens),
-                    jnp.asarray([n], jnp.int32),
-                    self.cache,
-                    jnp.asarray(table),
+            try:
+                seq = Sequence(
+                    seq_id, n, params=sampling, mask_fn=mask_fn, stream=stream
                 )
-            token = int(self._sample_one(logits, [seq])[0])
+                self.sequences[seq_id] = seq
+                tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+                tokens[0, :n] = prompt_ids
+                table = self.alloc.page_table_row(seq_id)[None, :]
+                with self.mesh:
+                    logits, self.cache = self._prefill_jit(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray([n], jnp.int32),
+                        self.cache,
+                        jnp.asarray(table),
+                    )
+                token = int(self._sample_one(logits, [seq])[0])
+            except Exception:
+                # Failed admissions (prefill OOM, raising mask_fn, ...) must
+                # not leak pages or a stale Sequence: the scheduler only
+                # learns seq_ids of successful admissions.
+                self.sequences.pop(seq_id, None)
+                self.alloc.free(seq_id)
+                raise
             seq.ttft_s = time.perf_counter() - t0
             perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
             perf.record_metric("engine.prefill_tokens", n, "tok")
@@ -226,9 +236,11 @@ class Engine:
 
     def _hit_stop_string(self, seq: Sequence) -> bool:
         """Check the decoded tail for any stop string, so generation halts at
-        the stop instead of burning decode steps to max_tokens."""
+        the stop instead of burning decode steps to max_tokens. The window is
+        sized in TOKENS: one char can span up to 4 byte-level tokens (UTF-8),
+        so a char-sized window would miss long multi-byte stop strings."""
         longest = max(len(s) for s in seq.params.stop)
-        tail_tokens = seq.tokens[-(longest + 8) :]
+        tail_tokens = seq.tokens[-(longest * 4 + 8) :]
         tail = self.tokenizer.decode(tail_tokens)
         return any(s in tail for s in seq.params.stop)
 

@@ -22,6 +22,11 @@ class PromptTooLong(Exception):
     largest prefill bucket); fail fast instead of queueing."""
 
 
+class InvalidRequest(ValueError):
+    """Malformed request (client's fault, HTTP 400) — distinct from engine
+    bugs that happen to raise ValueError, which must stay 5xx."""
+
+
 @dataclass
 class SeqAlloc:
     seq_id: int

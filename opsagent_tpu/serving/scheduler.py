@@ -21,10 +21,19 @@ import numpy as np
 from ..utils.logger import get_logger
 from ..utils.perf import get_perf_stats
 from .engine import Engine
-from .kvcache import OutOfPages, PromptTooLong
+from .kvcache import InvalidRequest, OutOfPages, PromptTooLong
 from .sampler import SamplingParams
 
 log = get_logger("scheduler")
+
+
+class RequestError(RuntimeError):
+    """A failed request with an HTTP-ish status classification (400 = the
+    request can never succeed, 500 = engine-side failure)."""
+
+    def __init__(self, message: str, status: int = 500):
+        super().__init__(message)
+        self.status = status
 
 
 @dataclass
@@ -38,6 +47,7 @@ class Request:
     tokens: list[int] = field(default_factory=list)
     finish_reason: str = ""
     error: str = ""
+    error_status: int = 500  # meaningful only when error is set
     done = None  # threading.Event, set in __post_init__
     enqueued_s: float = field(default_factory=time.perf_counter)
 
@@ -88,7 +98,7 @@ class Scheduler:
         if not req.done.wait(timeout_s):
             raise TimeoutError("generation timed out")
         if req.error:
-            raise RuntimeError(req.error)
+            raise RequestError(req.error, req.error_status)
         return req.tokens
 
     # -- loop --------------------------------------------------------------
@@ -125,6 +135,14 @@ class Scheduler:
             except PromptTooLong as e:
                 # Permanent: reject immediately with a clear error.
                 req.error = str(e)
+                req.error_status = 400
+                req.done.set()
+                continue
+            except InvalidRequest as e:
+                # Malformed request (e.g. empty prompt): the client's fault.
+                # Plain ValueErrors from engine internals stay 500 below.
+                req.error = f"admission failed: {e}"
+                req.error_status = 400
                 req.done.set()
                 continue
             except Exception as e:  # noqa: BLE001 - surfaced on the request

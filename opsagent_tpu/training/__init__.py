@@ -1,0 +1,6 @@
+from .trainer import (  # noqa: F401
+    TrainConfig,
+    cross_entropy_loss,
+    init_train_state,
+    make_train_step,
+)

@@ -199,3 +199,171 @@ def test_stop_strings(stack):
     )
     assert text == "He"
     assert finish == "stop"
+
+
+def test_prompt_too_long_http_status_400(stack):
+    """PromptTooLong is a permanent client error: the HTTP frontend must
+    return 400 (derived from the typed RequestError, not string matching)."""
+    app = build_engine_app(stack)
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "messages": [{"role": "user", "content": "x" * 500}],
+                    "max_tokens": 2,
+                },
+            )
+            assert r.status == 400, await r.text()
+        finally:
+            await client.close()
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(scenario())
+
+
+def test_failed_admission_does_not_leak_pages(stack):
+    """A request whose admission blows up mid-prefill (raising mask_fn fires
+    during first-token sampling) must free its pages."""
+    free_before = stack.engine.alloc.free_pages
+
+    def bad_mask(_tokens):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="admission failed|boom"):
+        stack.scheduler.complete(
+            [257, 1, 2, 3], SamplingParams(max_tokens=2),
+            mask_fn=bad_mask, timeout_s=30,
+        )
+    assert stack.engine.alloc.free_pages == free_before
+    assert not stack.engine.sequences
+
+
+class _ScriptedScheduler:
+    """Feeds a scripted token list through on_token, then completes."""
+
+    def __init__(self, tokens):
+        self.tokens = tokens
+
+    def submit(self, req):
+        for t in self.tokens:
+            if req.on_token:
+                req.on_token(t)
+        req.finish_reason = "length"
+        req.done.set()
+        return req
+
+
+class _FakeEngine:
+    def __init__(self):
+        from opsagent_tpu.serving.tokenizer import ByteTokenizer
+
+        self.tokenizer = ByteTokenizer()
+        self.cfg = EngineConfig(model="tiny-test")
+        self.model_cfg = type("M", (), {"name": "tiny-test"})()
+
+
+def _scripted_stack(tokens):
+    s = ServingStack.__new__(ServingStack)
+    s.engine = _FakeEngine()
+    s.scheduler = _ScriptedScheduler(tokens)
+    s.model_name = "tiny-test"
+    return s
+
+
+def test_stream_stop_string_straddles_chunks():
+    """Stop-string holdback: 'END' arriving one byte per token must still be
+    caught, and nothing after (or of) the stop string is emitted."""
+    text = "Hello END tail"
+    s = _scripted_stack(list(text.encode()))
+    chunks = list(
+        s.chat_completion_stream(
+            {"messages": [{"role": "user", "content": "q"}], "stop": ["END"]}
+        )
+    )
+    content = "".join(
+        c["choices"][0]["delta"].get("content", "")
+        for c in chunks
+        if "choices" in c
+    )
+    assert content == "Hello "
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+def test_stream_multibyte_char_split_across_tokens():
+    """A UTF-8 char whose bytes span tokens must be withheld until complete,
+    then emitted exactly once."""
+    text = "日本語 ok"
+    s = _scripted_stack(list(text.encode("utf-8")))
+    chunks = list(
+        s.chat_completion_stream({"messages": [{"role": "user", "content": "q"}]})
+    )
+    content = "".join(
+        c["choices"][0]["delta"].get("content", "")
+        for c in chunks
+        if "choices" in c
+    )
+    assert content == text
+    assert "�" not in content
+
+
+def test_tpu_scheme_lazy_registration_fresh_process():
+    """In a fresh process that never imports the serving stack, the agent's
+    ChatClient must still resolve --model tpu://<name> (the provider module
+    is imported lazily on first use)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from opsagent_tpu.llm.client import ChatClient\n"
+        "import sys\n"
+        "assert not any('serving' in m for m in sys.modules), 'not lazy'\n"
+        "r = ChatClient().chat_completion(\n"
+        "    'tpu://tiny-test', [{'role': 'user', 'content': 'hi'}], max_tokens=2)\n"
+        "assert r['choices'][0]['message'] is not None\n"
+        "print('LAZY_OK')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, cwd="/root/repo",
+    )
+    assert "LAZY_OK" in out.stdout, out.stderr[-2000:]
+
+
+def test_stream_bad_sampling_param_returns_json_error(stack):
+    """A translation error on a stream=true request must return a JSON error
+    status, not a dead SSE connection."""
+    app = build_engine_app(stack)
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": "many",
+                    "stream": True,
+                },
+            )
+            assert r.status == 500
+            assert "error" in await r.json()
+        finally:
+            await client.close()
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(scenario())
+
+
+def test_multibyte_stop_string_halts_engine_side(stack):
+    """A CJK stop string (3 UTF-8 byte-tokens per char) must stop generation
+    engine-side well before max_tokens (token window sized in bytes)."""
+    from opsagent_tpu.serving.engine import Sequence
+
+    seq = Sequence(seq_id=0, prompt_len=1, params=SamplingParams(stop=("終了" * 5,)))
+    seq.tokens = list(("x" + "終了" * 5).encode("utf-8"))
+    assert stack.engine._hit_stop_string(seq)
