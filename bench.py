@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Headline benchmark: paged-decode throughput (tokens/sec/chip).
+
+Runs the serving engine's continuous-batching decode loop at steady state and
+reports aggregate decode tokens/sec divided by chip count — the north-star
+serving metric from BASELINE.json (target: 2000 tok/s/chip, Llama-3-8B class,
+v5e). Prints ONE JSON line on stdout:
+
+    {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
+
+Model/batch are overridable via env (OPSAGENT_BENCH_MODEL,
+OPSAGENT_BENCH_BATCH, OPSAGENT_BENCH_STEPS). On a CPU-only host the bench
+automatically drops to the tiny test model so it still completes; the
+recorded number is only meaningful on TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_TOK_S_PER_CHIP = 2000.0  # BASELINE.json north_star decode target
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    n_chips = len(jax.devices())
+
+    model = os.environ.get(
+        "OPSAGENT_BENCH_MODEL", "bench-1b" if on_tpu else "tiny-test"
+    )
+    batch = int(os.environ.get("OPSAGENT_BENCH_BATCH", "16" if on_tpu else "4"))
+    steps = int(os.environ.get("OPSAGENT_BENCH_STEPS", "128" if on_tpu else "16"))
+    prompt_len = int(os.environ.get("OPSAGENT_BENCH_PROMPT", "128"))
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    log(f"bench: platform={platform} chips={n_chips} model={model} "
+        f"batch={batch} steps={steps}")
+
+    cfg = EngineConfig(
+        model=model,
+        dtype=dtype,
+        max_batch_size=batch,
+        num_pages=max(512, batch * 40),
+        page_size=16,
+        max_pages_per_seq=40,  # 128 prompt + up to ~512 generated
+        prefill_buckets=(prompt_len,),
+    )
+    t0 = time.perf_counter()
+    eng = Engine(cfg)
+    log(f"bench: engine init (weights+shard) {time.perf_counter() - t0:.1f}s")
+
+    rng = np.random.default_rng(0)
+    vocab = eng.model_cfg.vocab_size
+    sampling = SamplingParams(temperature=0.0, max_tokens=10**9)
+
+    # Admit a full batch; first admission triggers prefill compilation.
+    t0 = time.perf_counter()
+    ids = []
+    ttfts = []
+    for i in range(batch):
+        prompt = rng.integers(1, vocab, size=prompt_len).tolist()
+        t1 = time.perf_counter()
+        sid = eng.add_request(prompt, sampling)
+        ttfts.append(time.perf_counter() - t1)
+        ids.append(sid)
+    log(f"bench: admitted {batch} reqs in {time.perf_counter() - t0:.1f}s "
+        f"(first includes prefill compile)")
+
+    # Warm up decode (compilation + cache donation settle).
+    for _ in range(4):
+        eng.step(ids)
+    jax.block_until_ready(eng.cache)
+
+    # Steady-state decode.
+    t0 = time.perf_counter()
+    produced = 0
+    for _ in range(steps):
+        out = eng.step(ids)
+        produced += len(out)
+    jax.block_until_ready(eng.cache)
+    dt = time.perf_counter() - t0
+
+    tok_s = produced / dt
+    tok_s_chip = tok_s / n_chips
+    # Post-warmup TTFT (compile-free) from the later admissions.
+    p50_ttft_ms = float(np.median(ttfts[1:]) * 1e3) if len(ttfts) > 1 else 0.0
+
+    log(f"bench: {produced} tokens in {dt:.2f}s -> {tok_s:.0f} tok/s total, "
+        f"{tok_s_chip:.0f} tok/s/chip; p50 TTFT {p50_ttft_ms:.0f} ms")
+
+    print(json.dumps({
+        "metric": f"paged_decode_throughput[{model},B={batch},{platform}]",
+        "value": round(tok_s_chip, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s_chip / BASELINE_TOK_S_PER_CHIP, 3),
+        "extra": {
+            "total_tok_s": round(tok_s, 1),
+            "p50_ttft_ms": round(p50_ttft_ms, 1),
+            "chips": n_chips,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,16 @@ class ServingStack:
         self.model_name = engine.model_cfg.name
 
     # -- request translation ------------------------------------------------
+    def _translate(
+        self, body: dict[str, Any]
+    ) -> tuple[SamplingParams, list[int]]:
+        """Body -> (sampling, prompt_ids); malformed client params (e.g.
+        max_tokens="many") become a 400, not a retryable 500."""
+        try:
+            return self._sampling_from(body), self._prompt_ids(body)
+        except (ValueError, TypeError, KeyError) as e:
+            raise RequestError(f"invalid request: {e}", 400) from e
+
     def _sampling_from(self, body: dict[str, Any]) -> SamplingParams:
         return SamplingParams(
             temperature=float(body.get("temperature", 0.0) or 0.0),
@@ -111,8 +121,7 @@ class ServingStack:
 
     # -- chat.completions ---------------------------------------------------
     def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
-        sampling = self._sampling_from(body)
-        prompt_ids = self._prompt_ids(body)
+        sampling, prompt_ids = self._translate(body)
         t0 = time.time()
         req = Request(prompt_ids, sampling)
         self.scheduler.submit(req)
@@ -144,8 +153,7 @@ class ServingStack:
 
     def chat_completion_stream(self, body: dict[str, Any]):
         """Generator of SSE chunk dicts (sync; drive from a thread)."""
-        sampling = self._sampling_from(body)
-        prompt_ids = self._prompt_ids(body)
+        sampling, prompt_ids = self._translate(body)
         token_q: "queue.Queue[int | None]" = queue.Queue()
         req = Request(
             prompt_ids, sampling, on_token=lambda t: token_q.put(t)
@@ -168,11 +176,23 @@ class ServingStack:
                 ],
             }
 
-        yield chunk({"role": "assistant", "content": ""})
         watchdog = threading.Thread(
             target=lambda: (req.done.wait(600), token_q.put(None)), daemon=True
         )
         watchdog.start()
+        # Hold the first SSE chunk until the admission outcome is known:
+        # admission failures (prompt too long, engine saturated) must surface
+        # as an HTTP error status, not a 200 followed by an in-stream error.
+        first_tok = token_q.get()
+        if first_tok is None and req.error:
+            raise RequestError(req.error, req.error_status)
+        yield chunk({"role": "assistant", "content": ""})
+
+        def _tokens():
+            t = first_tok
+            while t is not None:
+                yield t
+                t = token_q.get()
         # Incremental detokenization with a SLIDING window (vLLM-style):
         # decode only tokens[prefix_off:] and diff against the same window's
         # previous decode, so per-token cost is O(window), not O(total).
@@ -185,10 +205,7 @@ class ServingStack:
         read_off = 0     # tokens already diffed within the window
         pending = ""     # decoded but unemitted (stop-string holdback)
         stopped = False
-        while True:
-            tok = token_q.get()
-            if tok is None:
-                break
+        for tok in _tokens():
             if tok == eos or stopped:
                 continue
             sent.append(tok)

@@ -180,17 +180,18 @@ class Engine:
                         jnp.asarray(table),
                     )
                 token = int(self._sample_one(logits, [seq])[0])
+                seq.ttft_s = time.perf_counter() - t0
+                perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
+                perf.record_metric("engine.prefill_tokens", n, "tok")
+                self._accept_token(seq, token)
             except Exception:
-                # Failed admissions (prefill OOM, raising mask_fn, ...) must
-                # not leak pages or a stale Sequence: the scheduler only
-                # learns seq_ids of successful admissions.
+                # Failed admissions (prefill OOM, raising mask_fn, a raising
+                # stream callback on the first token, ...) must not leak
+                # pages or a stale Sequence: the scheduler only learns
+                # seq_ids of successful admissions.
                 self.sequences.pop(seq_id, None)
                 self.alloc.free(seq_id)
                 raise
-            seq.ttft_s = time.perf_counter() - t0
-            perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
-            perf.record_metric("engine.prefill_tokens", n, "tok")
-            self._accept_token(seq, token)
             return seq_id
 
     def _sample_one(self, logits: jax.Array, seqs: list[Sequence]) -> np.ndarray:

@@ -351,12 +351,55 @@ def test_stream_bad_sampling_param_returns_json_error(stack):
                     "stream": True,
                 },
             )
-            assert r.status == 500
+            assert r.status == 400
             assert "error" in await r.json()
         finally:
             await client.close()
 
     asyncio.get_event_loop_policy().new_event_loop().run_until_complete(scenario())
+
+
+def test_stream_prompt_too_long_http_status_400(stack):
+    """An ADMISSION error (prompt exceeds the largest prefill bucket) on a
+    stream=true request must surface as HTTP 400, not a 200 SSE stream with
+    an in-stream error event."""
+    app = build_engine_app(stack)
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "messages": [{"role": "user", "content": "x" * 500}],
+                    "max_tokens": 2,
+                    "stream": True,
+                },
+            )
+            assert r.status == 400, await r.text()
+            assert "error" in await r.json()
+        finally:
+            await client.close()
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(scenario())
+
+
+def test_raising_stream_callback_does_not_leak_pages(stack):
+    """A stream/on_token callback that raises on the FIRST token (delivered
+    during admission) must not leak pages or a zombie Sequence."""
+    free_before = stack.engine.alloc.free_pages
+
+    def bad_stream(_tok):
+        raise RuntimeError("stream boom")
+
+    with pytest.raises(RuntimeError, match="admission failed|stream boom"):
+        stack.scheduler.complete(
+            [257, 1, 2, 3], SamplingParams(max_tokens=2),
+            on_token=bad_stream, timeout_s=30,
+        )
+    assert stack.engine.alloc.free_pages == free_before
+    assert not stack.engine.sequences
 
 
 def test_multibyte_stop_string_halts_engine_side(stack):
