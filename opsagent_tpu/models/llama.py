@@ -26,7 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import (
     causal_prefill_attention,
-    paged_decode_attention,
+    paged_decode_attention_auto,
     write_kv_pages,
 )
 from ..ops.rope import apply_rope, rope_table
@@ -206,6 +206,7 @@ def decode_step(
     page_table: jax.Array,   # [B, MaxP]
     active: jax.Array,       # [B] bool; inactive slots skip the page write
     dtype: jnp.dtype = jnp.bfloat16,
+    attn_impl: str = "xla",  # "xla" | "pallas" (ops.paged_attention_backend)
 ) -> tuple[jax.Array, Params]:
     """One decode step for a batch of sequences; returns ([B, V] logits,
     updated cache)."""
@@ -224,8 +225,9 @@ def decode_step(
         k_pages, v_pages = write_kv_pages(
             k_pages, v_pages, k, v, page_table, lengths, valid_len=valid
         )
-        attn = paged_decode_attention(
-            q[:, 0], k_pages, v_pages, page_table, lengths + valid
+        attn = paged_decode_attention_auto(
+            q[:, 0], k_pages, v_pages, page_table, lengths + valid,
+            impl=attn_impl,
         )
         x = x + attn.reshape(B, 1, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -14,10 +14,48 @@ the Ragged-Paged-Attention design in PAPERS.md).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def paged_attention_backend(tp: int = 1) -> str:
+    """Which decode-attention implementation to use: "pallas" (TPU kernel)
+    or "xla" (gather-based reference). Env OPSAGENT_PAGED_BACKEND overrides;
+    default picks the Pallas kernel on TPU when the program is not
+    tensor-parallel-sharded (a bare pallas_call is opaque to the pjit
+    partitioner; the tp>1 path keeps the XLA reference until the kernel is
+    shard_map-wrapped)."""
+    choice = os.environ.get("OPSAGENT_PAGED_BACKEND", "auto")
+    if choice in ("pallas", "xla"):
+        return choice
+    if choice != "auto":
+        raise ValueError(
+            f"OPSAGENT_PAGED_BACKEND={choice!r}: expected pallas, xla, or auto"
+        )
+    return "pallas" if (jax.default_backend() == "tpu" and tp == 1) else "xla"
+
+
+def paged_decode_attention_auto(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    impl: str = "xla",
+) -> jax.Array:
+    """Impl-dispatched paged decode attention (impl from
+    ``paged_attention_backend``, resolved at trace time by the caller)."""
+    if impl == "pallas":
+        from .paged_attention_pallas import paged_decode_attention_pallas
+
+        return paged_decode_attention_pallas(
+            q, k_pages, v_pages, page_table, lengths
+        )
+    return paged_decode_attention(q, k_pages, v_pages, page_table, lengths)
 
 
 def causal_prefill_attention(

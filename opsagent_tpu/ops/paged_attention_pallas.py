@@ -1,0 +1,176 @@
+"""Pallas TPU kernel: ragged paged-attention for the decode step.
+
+The XLA reference (``ops.attention.paged_decode_attention``) gathers every
+sequence's pages into a dense ``[B, MaxP*P, K, D]`` tensor each decode step —
+HBM traffic proportional to the page-table CAPACITY, not to the tokens
+actually resident. This kernel instead streams exactly the pages each
+sequence owns through VMEM via the Pallas pipeline (the scalar-prefetched
+page table drives the k/v BlockSpec index maps), with a flash-attention-style
+online softmax so nothing is materialized.
+
+Grid: ``(B, MaxP)`` — page axis innermost so the f32 accumulators in VMEM
+scratch carry across a sequence's pages. Each grid step DMAs one whole page
+``[P, K, D]`` (all kv heads at once); blocks therefore span full trailing
+axes, which satisfies the TPU tiling rule (last two block dims divisible by
+(8, 128) OR equal to the array's). Pages past a sequence's length clamp
+their index map to the last valid page: the pipeline sees an unchanged block
+index and skips the refetch, so ragged sequences pay only for the pages they
+own.
+
+Correctness oracle: ``ops.attention.paged_decode_attention`` (compared in
+interpret mode on CPU and compiled on TPU). No Go counterpart exists in the
+reference — this replaces its remote-LLM HTTPS hop (pkg/llms/openai.go:69).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    table_ref,     # [B, MaxP] int32 page indices (-1 = unassigned)
+    lengths_ref,   # [B] int32 tokens in cache (incl. the one being written)
+    # blocks
+    q_ref,         # [1, H, D]
+    k_ref,         # [1, P, K, D]   (one page, all kv heads)
+    v_ref,         # [1, P, K, D]
+    o_ref,         # [1, H, D]
+    # scratch
+    acc_ref,       # [H, D]  f32
+    m_ref,         # [H, 128] f32 (running max, lane-broadcast)
+    l_ref,         # [H, 128] f32 (running denominator)
+    *,
+    page_size: int,
+    num_kv_heads: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    P = page_size
+    K = num_kv_heads
+    H = q_ref.shape[1]
+    G = H // K
+    length = lengths_ref[b]
+    num_pages = pl.cdiv(length, P)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(p < num_pages)
+    def _accumulate():
+        D = q_ref.shape[-1]
+        scale = D ** -0.5
+        # One big MXU dot against ALL kv heads' keys at once (with P*K=128
+        # this is a single full MXU tile), then select each query head's own
+        # group on the VPU. K× redundant MXU FLOPs, but the decode step is
+        # HBM-bandwidth-bound and the MXU is otherwise idle — this beats K
+        # sublane-misaligned [G,D]x[D,P] dots by a wide margin.
+        q = q_ref[0].astype(jnp.float32) * scale           # [H, D]
+        kf = k_ref[0].reshape(P * K, D)                    # [P*K, D] row p*K+k
+        vf = v_ref[0].reshape(P * K, D)
+        s_full = jax.lax.dot_general(
+            q, kf,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # [H, P*K]
+        # Column c holds (token p*P + c//K, kv head c%K). Mask columns whose
+        # kv head is not this query head's group (and out-of-range tokens) to
+        # -inf and run the online softmax directly in the [H, P*K] domain —
+        # masked columns contribute exp(-inf)=0, so the probs matrix is
+        # already laid out for one dot against vf. No lane-splitting
+        # reshapes, which Mosaic cannot lower.
+        col = jax.lax.broadcasted_iota(jnp.int32, (H, P * K), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (H, P * K), 0)
+        sel = (col % K == row // G) & (p * P + col // K < length)
+        s = jnp.where(sel, s_full, NEG_INF)                # [H, P*K]
+
+        m_prev = m_ref[:, :1]                              # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                    # [H, 1]
+        probs = jnp.exp(s - m_new)                         # [H, P*K]
+        l_new = alpha[:, 0] * l_ref[:, 0] + jnp.sum(probs, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            probs, vf.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_ref[:, :1]                                   # [H, 1]
+        safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+
+
+def _page_index(b, p, table_ref, lengths_ref, *, page_size):
+    """Block index of the page to DMA for grid step (b, p); clamps
+    past-the-end steps to the last valid page so the pipeline sees an
+    unchanged index and skips the refetch."""
+    num_pages = pl.cdiv(lengths_ref[b], page_size)
+    last = jnp.maximum(num_pages - 1, 0)
+    page = table_ref[b, jnp.minimum(p, last)]
+    return (jnp.maximum(page, 0), 0, 0, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jax.Array,           # [B, H, D] (one new token per sequence)
+    k_pages: jax.Array,     # [N, P, K, D]
+    v_pages: jax.Array,     # [N, P, K, D]
+    page_table: jax.Array,  # [B, MaxP] int32
+    lengths: jax.Array,     # [B] int32 (incl. the token being decoded)
+    interpret: bool = False,
+) -> jax.Array:
+    N, P, K, D = k_pages.shape
+    B, H, _ = q.shape
+    MaxP = page_table.shape[1]
+
+    page_map = functools.partial(_page_index, page_size=P)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MaxP),
+        in_specs=[
+            pl.BlockSpec(
+                (1, H, D), lambda b, p, t, ln: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, D), lambda b, p, t, ln: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, page_size=P, num_kv_heads=K),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * B * H * D * MaxP * P,
+            bytes_accessed=(
+                B * MaxP * P * K * D * 2 * k_pages.dtype.itemsize
+                + B * H * D * 2 * q.dtype.itemsize
+            ),
+            transcendentals=B * H * MaxP * P,
+        ),
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pages, v_pages)
+    return out

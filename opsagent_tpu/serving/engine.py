@@ -119,13 +119,18 @@ class Engine:
         self._sample_key = jax.random.PRNGKey(cfg.seed + 1)
 
         mc, dt = self.model_cfg, cfg.dtype
+        from ..ops.attention import paged_attention_backend
+
+        self.attn_impl = paged_attention_backend(tp=tp)
+        log.info("paged decode attention impl: %s (tp=%d)", self.attn_impl, tp)
 
         def _prefill(params, tokens, lengths, cache, table):
             return llama.prefill(params, mc, tokens, lengths, cache, table, dtype=dt)
 
         def _decode(params, tokens, lengths, cache, table, active):
             return llama.decode_step(
-                params, mc, tokens, lengths, cache, table, active, dtype=dt
+                params, mc, tokens, lengths, cache, table, active, dtype=dt,
+                attn_impl=self.attn_impl,
             )
 
         self._prefill_jit = jax.jit(_prefill, donate_argnames=("cache",))

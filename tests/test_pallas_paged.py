@@ -1,0 +1,138 @@
+"""Pallas paged-decode-attention kernel vs the XLA gather reference.
+
+Runs the kernel in interpreter mode on CPU (the TPU-lowered path shares the
+same trace), asserting numerical equivalence with
+``ops.attention.paged_decode_attention`` across ragged lengths, GQA group
+sizes, multi-page sequences, and inactive (length-0) batch slots.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from opsagent_tpu.ops.attention import paged_decode_attention
+from opsagent_tpu.ops.paged_attention_pallas import paged_decode_attention_pallas
+
+
+def _make_case(
+    rng, B, H, K, D, P, MaxP, num_pages, lengths,
+):
+    """Random paged KV state with each sequence owning disjoint pages."""
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k_pages = jnp.asarray(rng.standard_normal((num_pages, P, K, D)), jnp.float32)
+    v_pages = jnp.asarray(rng.standard_normal((num_pages, P, K, D)), jnp.float32)
+    table = np.full((B, MaxP), -1, np.int32)
+    free = list(range(num_pages))
+    rng.shuffle(free)
+    for b, n in enumerate(lengths):
+        need = -(-n // P)
+        for i in range(need):
+            table[b, i] = free.pop()
+    return q, k_pages, v_pages, jnp.asarray(table), jnp.asarray(lengths, jnp.int32)
+
+
+@pytest.mark.parametrize(
+    "B,H,K,D,P,MaxP,lengths",
+    [
+        (2, 4, 2, 64, 8, 4, [5, 17]),          # GQA, ragged, multi-page
+        (1, 2, 2, 32, 4, 6, [24]),             # MHA (G=1), exactly full pages
+        (3, 8, 2, 16, 8, 3, [1, 8, 20]),       # boundary lengths
+        (2, 4, 4, 32, 8, 4, [9, 0]),           # inactive slot (length 0)
+    ],
+)
+def test_pallas_matches_xla_reference(B, H, K, D, P, MaxP, lengths):
+    rng = np.random.default_rng(0)
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B, H, K, D, P, MaxP, num_pages=B * MaxP + 2, lengths=lengths
+    )
+    ref = paged_decode_attention(q, k_pages, v_pages, table, lens)
+    got = paged_decode_attention_pallas(
+        q, k_pages, v_pages, table, lens, interpret=True
+    )
+    # Inactive slots: the kernel defines them as zeros; the reference
+    # produces attention over a masked-everything row (softmax of -inf) —
+    # compare only active rows, then check the kernel's zeros.
+    active = np.asarray(lens) > 0
+    np.testing.assert_allclose(
+        np.asarray(got)[active], np.asarray(ref)[active], rtol=2e-5, atol=2e-5
+    )
+    assert not np.isnan(np.asarray(got)).any()
+    if (~active).any():
+        np.testing.assert_array_equal(np.asarray(got)[~active], 0.0)
+
+
+def test_pallas_bf16_tolerance():
+    rng = np.random.default_rng(1)
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B=2, H=4, K=2, D=64, P=8, MaxP=4, num_pages=12, lengths=[13, 29]
+    )
+    q, k_pages, v_pages = (
+        x.astype(jnp.bfloat16) for x in (q, k_pages, v_pages)
+    )
+    ref = paged_decode_attention(q, k_pages, v_pages, table, lens)
+    got = paged_decode_attention_pallas(
+        q, k_pages, v_pages, table, lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_decode_step_with_pallas_impl_matches_xla():
+    """End-to-end: llama.decode_step with attn_impl="pallas" (interpret via
+    env is not available, so call through the model with monkeypatched
+    dispatcher interpret flag) equals the xla impl."""
+    from opsagent_tpu.models import llama
+    from opsagent_tpu.models.config import get_config_preset
+    from opsagent_tpu.ops import paged_attention_pallas as pp
+
+    cfg = get_config_preset("tiny-test")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    P, NP, MaxP, B = 8, 16, 4, 2
+    cache = llama.make_cache(cfg, NP, P, dtype=jnp.float32)
+
+    # Prefill two sequences to populate pages.
+    lens = [5, 9]
+    table = np.full((B, MaxP), -1, np.int32)
+    table[0, :2] = [0, 1]
+    table[1, :2] = [2, 3]
+    S = 16
+    tokens = np.zeros((B, S), np.int32)
+    rng = np.random.default_rng(2)
+    for b, n in enumerate(lens):
+        tokens[b, :n] = rng.integers(1, cfg.vocab_size, n)
+    logits, cache = llama.prefill(
+        params, cfg, jnp.asarray(tokens), jnp.asarray(lens, jnp.int32),
+        cache, jnp.asarray(table), dtype=jnp.float32,
+    )
+
+    step_args = (
+        jnp.asarray([7, 8], jnp.int32),
+        jnp.asarray(lens, jnp.int32),
+    )
+    out_xla, _ = llama.decode_step(
+        params, cfg, step_args[0], step_args[1], cache,
+        jnp.asarray(table), jnp.asarray([True, True]),
+        dtype=jnp.float32, attn_impl="xla",
+    )
+
+    # Force interpret mode inside the pallas path for the CPU test.
+    orig = pp.paged_decode_attention_pallas
+
+    def interp(q, k, v, t, ln, interpret=False):
+        return orig(q, k, v, t, ln, interpret=True)
+
+    pp.paged_decode_attention_pallas = interp
+    try:
+        out_pl, _ = llama.decode_step(
+            params, cfg, step_args[0], step_args[1], cache,
+            jnp.asarray(table), jnp.asarray([True, True]),
+            dtype=jnp.float32, attn_impl="pallas",
+        )
+    finally:
+        pp.paged_decode_attention_pallas = orig
+    np.testing.assert_allclose(
+        np.asarray(out_xla), np.asarray(out_pl), rtol=1e-4, atol=1e-4
+    )
