@@ -115,12 +115,30 @@ def _react_loop(
     # for the prompt (and the constrictor would evict history to nothing).
     max_tokens = min(max_tokens, max(256, get_token_limits(model) // 2))
 
-    def call(msgs: list[dict[str, Any]]) -> str:
+    # Against the in-tree engine, constrain decoding to the ToolPrompt JSON
+    # schema on device — replies are valid by construction, so the repair
+    # ladder below becomes dead code on this path (SURVEY.md §7 step 6).
+    # Remote providers keep free-form output + repair (reference behavior).
+    toolprompt_rf = None
+    if (model or "").startswith("tpu://") or (base_url or "").startswith("tpu://"):
+        from ..serving.constrained import TOOLPROMPT_SCHEMA
+
+        toolprompt_rf = {
+            "type": "json_schema",
+            "json_schema": {"schema": TOOLPROMPT_SCHEMA},
+        }
+
+    def call(
+        msgs: list[dict[str, Any]],
+        response_format: dict[str, Any] | None = None,
+    ) -> str:
         sendable = constrict_messages(msgs, model, max_tokens) if count_tokens else msgs
         with ps.timer("agent.llm_turn"):
-            return client.chat(model, max_tokens, sendable)
+            return client.chat(
+                model, max_tokens, sendable, response_format=response_format
+            )
 
-    reply = call(chat_history)
+    reply = call(chat_history, response_format=toolprompt_rf)
     chat_history.append({"role": "assistant", "content": reply})
     if verbose:
         log.info("initial reply: %s", reply[:500])
@@ -175,7 +193,7 @@ def _react_loop(
         prompt.observation = constrict_prompt(observation, OBSERVATION_TOKEN_LIMIT)
         chat_history.append({"role": "user", "content": prompt.to_json()})
 
-        reply = call(chat_history)
+        reply = call(chat_history, response_format=toolprompt_rf)
         chat_history.append({"role": "assistant", "content": reply})
         if verbose:
             log.info("iteration %d reply: %s", iterations, reply[:500])

@@ -45,13 +45,42 @@ class ServingStack:
     # -- request translation ------------------------------------------------
     def _translate(
         self, body: dict[str, Any]
-    ) -> tuple[SamplingParams, list[int]]:
-        """Body -> (sampling, prompt_ids); malformed client params (e.g.
-        max_tokens="many") become a 400, not a retryable 500."""
+    ) -> tuple[SamplingParams, list[int], Any]:
+        """Body -> (sampling, prompt_ids, mask_fn); malformed client params
+        (e.g. max_tokens="many") become a 400, not a retryable 500."""
         try:
-            return self._sampling_from(body), self._prompt_ids(body)
+            return (
+                self._sampling_from(body),
+                self._prompt_ids(body),
+                self._constraint_from(body),
+            )
         except (ValueError, TypeError, KeyError) as e:
             raise RequestError(f"invalid request: {e}", 400) from e
+
+    def _constraint_from(self, body: dict[str, Any]):
+        """OpenAI ``response_format`` -> constrained-decoding mask_fn.
+        ``json_object`` constrains to any JSON value; ``json_schema`` to the
+        given schema (on-device FSM masking — the engine-side replacement
+        for the reference's JSON-repair ladder, pkg/utils/json.go:16)."""
+        rf = body.get("response_format")
+        if not rf:
+            return None
+        if not isinstance(rf, dict):
+            raise ValueError(f"response_format must be an object, got {rf!r}")
+        from .constrained import json_constraint
+
+        kind = rf.get("type")
+        if kind == "json_object":
+            return json_constraint(self.engine.tokenizer, None)
+        if kind == "json_schema":
+            spec = rf.get("json_schema") or {}
+            if not isinstance(spec, dict):
+                raise ValueError("response_format.json_schema must be an object")
+            schema = spec.get("schema", spec if "properties" in spec else {})
+            if not isinstance(schema, dict):
+                raise ValueError("json_schema.schema must be an object")
+            return json_constraint(self.engine.tokenizer, schema or None)
+        raise ValueError(f"unsupported response_format type {kind!r}")
 
     def _sampling_from(self, body: dict[str, Any]) -> SamplingParams:
         return SamplingParams(
@@ -121,9 +150,9 @@ class ServingStack:
 
     # -- chat.completions ---------------------------------------------------
     def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
-        sampling, prompt_ids = self._translate(body)
+        sampling, prompt_ids, mask_fn = self._translate(body)
         t0 = time.time()
-        req = Request(prompt_ids, sampling)
+        req = Request(prompt_ids, sampling, mask_fn=mask_fn)
         self.scheduler.submit(req)
         if not req.done.wait(600):
             raise TimeoutError("generation timed out")
@@ -153,10 +182,10 @@ class ServingStack:
 
     def chat_completion_stream(self, body: dict[str, Any]):
         """Generator of SSE chunk dicts (sync; drive from a thread)."""
-        sampling, prompt_ids = self._translate(body)
+        sampling, prompt_ids, mask_fn = self._translate(body)
         token_q: "queue.Queue[int | None]" = queue.Queue()
         req = Request(
-            prompt_ids, sampling, on_token=lambda t: token_q.put(t)
+            prompt_ids, sampling, mask_fn=mask_fn, on_token=lambda t: token_q.put(t)
         )
         self.scheduler.submit(req)
         created = int(time.time())

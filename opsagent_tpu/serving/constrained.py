@@ -1,0 +1,401 @@
+"""Constrained decoding: JSON-schema → byte DFA → token-level logit masks.
+
+The reference keeps its ReAct loop alive with a JSON-repair ladder
+(reference pkg/utils/json.go:16-190 and the 4-stage tolerant parse in
+pkg/handlers/execute.go:250-404) because remote models emit broken JSON.
+This module deletes that failure class at the source: the serving engine
+masks logits each decode step so the model can only emit bytes a JSON
+schema's automaton accepts (SURVEY.md §7 step 6).
+
+Pipeline:
+
+1. A tiny regex AST (literal byte-sets, sequence, alternation, repetition)
+   compiled via Thompson construction + subset construction into a byte-level
+   DFA. JSON nesting is context-free, but bounding the depth (default 4
+   for schemaless json_object — the NFA grows ~4^depth since objects and
+   arrays each embed two copies of the inner value; schema-guided DFAs stay
+   tiny) makes the language regular — the schema compiler unrolls nesting.
+2. ``TokenFSM`` lifts the DFA to the tokenizer's vocabulary: for a DFA state
+   the set of admissible tokens is "every byte of the token survives the
+   DFA". Masks are computed lazily per state and cached — generation loops
+   through a handful of distinct states (string-body states self-loop), so
+   the cache stays tiny even for 128k-token vocabularies. A native C++
+   matcher can precompute the full [states, vocab] table; this module is the
+   reference implementation and fallback.
+3. ``JsonConstraint`` is the engine-facing ``mask_fn`` (Engine.add_request's
+   hook): feed it the generated-token list, get a [vocab] bool mask. EOS is
+   admissible exactly in accepting states.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+import numpy as np
+
+# -- regex AST --------------------------------------------------------------
+# Nodes: ("lit", frozenset[int]) | ("seq", [n...]) | ("alt", [n...])
+#        | ("star", n) | ("opt", n) | ("plus", n)
+
+Node = tuple
+
+
+def lit(chars: Iterable[int] | bytes | str) -> Node:
+    if isinstance(chars, str):
+        chars = chars.encode("utf-8")
+    return ("lit", frozenset(chars))
+
+
+def text(s: str) -> Node:
+    return ("seq", [lit(bytes([b])) for b in s.encode("utf-8")])
+
+
+def seq(*nodes: Node) -> Node:
+    return ("seq", list(nodes))
+
+
+def alt(*nodes: Node) -> Node:
+    return ("alt", list(nodes))
+
+
+def star(node: Node) -> Node:
+    return ("star", node)
+
+
+def opt(node: Node) -> Node:
+    return ("opt", node)
+
+
+def plus(node: Node) -> Node:
+    return ("plus", node)
+
+
+# -- NFA (Thompson) ---------------------------------------------------------
+@dataclass
+class _NFA:
+    # transitions[state] = list of (byteset | None for epsilon, next_state)
+    transitions: list[list[tuple[frozenset | None, int]]] = field(
+        default_factory=list
+    )
+
+    def new_state(self) -> int:
+        self.transitions.append([])
+        return len(self.transitions) - 1
+
+    def add(self, s: int, byteset: frozenset | None, t: int) -> None:
+        self.transitions[s].append((byteset, t))
+
+
+def _build(nfa: _NFA, node: Node) -> tuple[int, int]:
+    """Compile a node; returns (start, end) NFA states."""
+    kind = node[0]
+    if kind == "lit":
+        s, e = nfa.new_state(), nfa.new_state()
+        nfa.add(s, node[1], e)
+        return s, e
+    if kind == "seq":
+        s = e = nfa.new_state()
+        for child in node[1]:
+            cs, ce = _build(nfa, child)
+            nfa.add(e, None, cs)
+            e = ce
+        return s, e
+    if kind == "alt":
+        s, e = nfa.new_state(), nfa.new_state()
+        for child in node[1]:
+            cs, ce = _build(nfa, child)
+            nfa.add(s, None, cs)
+            nfa.add(ce, None, e)
+        return s, e
+    if kind in ("star", "opt", "plus"):
+        cs, ce = _build(nfa, node[1])
+        s, e = nfa.new_state(), nfa.new_state()
+        nfa.add(s, None, cs)
+        if kind != "plus":
+            nfa.add(s, None, e)
+        nfa.add(ce, None, e)
+        if kind != "opt":
+            nfa.add(ce, None, cs)
+        return s, e
+    raise ValueError(f"unknown regex node {kind!r}")
+
+
+# -- DFA (subset construction) ----------------------------------------------
+@dataclass
+class ByteDFA:
+    """Dense byte-level DFA: next[state*256 + byte] -> state or -1 (dead)."""
+
+    next: np.ndarray          # [num_states * 256] int32
+    accept: np.ndarray        # [num_states] bool
+    start: int = 0
+
+    @property
+    def num_states(self) -> int:
+        return len(self.accept)
+
+    def step(self, state: int, byte: int) -> int:
+        if state < 0:
+            return -1
+        return int(self.next[state * 256 + byte])
+
+    def run(self, state: int, data: bytes) -> int:
+        for b in data:
+            state = self.step(state, b)
+            if state < 0:
+                return -1
+        return state
+
+
+def compile_regex(node: Node) -> ByteDFA:
+    nfa = _NFA()
+    start, end = _build(nfa, node)
+
+    def eclose(states: frozenset[int]) -> frozenset[int]:
+        stack, seen = list(states), set(states)
+        while stack:
+            s = stack.pop()
+            for byteset, t in nfa.transitions[s]:
+                if byteset is None and t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        return frozenset(seen)
+
+    start_set = eclose(frozenset([start]))
+    state_ids: dict[frozenset, int] = {start_set: 0}
+    worklist = [start_set]
+    rows: list[np.ndarray] = []
+    accept: list[bool] = []
+    while worklist:
+        cur = worklist.pop()
+        sid = state_ids[cur]
+        while len(rows) <= sid:
+            rows.append(np.full((256,), -1, np.int32))
+            accept.append(False)
+        accept[sid] = end in cur
+        # Group reachable targets per byte.
+        per_byte: dict[int, set[int]] = {}
+        for s in cur:
+            for byteset, t in nfa.transitions[s]:
+                if byteset is None:
+                    continue
+                for b in byteset:
+                    per_byte.setdefault(b, set()).add(t)
+        for b, targets in per_byte.items():
+            tset = eclose(frozenset(targets))
+            if tset not in state_ids:
+                state_ids[tset] = len(state_ids)
+                worklist.append(tset)
+            rows[sid][b] = state_ids[tset]
+    # Worklist order may have appended rows out of order; normalize.
+    n = len(state_ids)
+    nxt = np.full((n, 256), -1, np.int32)
+    acc = np.zeros((n,), bool)
+    for sset, sid in state_ids.items():
+        if sid < len(rows):
+            nxt[sid] = rows[sid]
+            acc[sid] = accept[sid]
+    return ByteDFA(next=nxt.reshape(-1), accept=acc, start=0)
+
+
+# -- JSON schema → regex ----------------------------------------------------
+_WS = star(lit(b" \t\n\r"))
+
+# String body: any byte except '"', '\' and C0 controls, or an escape.
+_STRING_CHAR = lit(frozenset(range(0x20, 0x100)) - {0x22, 0x5C})
+_ESCAPE = seq(
+    lit(b"\\"),
+    alt(
+        lit(b'"\\/bfnrt'),
+        seq(lit(b"u"), *([lit(b"0123456789abcdefABCDEF")] * 4)),
+    ),
+)
+_STRING = seq(lit(b'"'), star(alt(_STRING_CHAR, _ESCAPE)), lit(b'"'))
+_NUMBER = seq(
+    opt(lit(b"-")),
+    alt(lit(b"0"), seq(lit(b"123456789"), star(lit(b"0123456789")))),
+    opt(seq(lit(b"."), plus(lit(b"0123456789")))),
+    opt(seq(lit(b"eE"), opt(lit(b"+-")), plus(lit(b"0123456789")))),
+)
+_BOOL = alt(text("true"), text("false"))
+_NULL = text("null")
+
+
+def _json_value(depth: int) -> Node:
+    """Any JSON value with nesting bounded at ``depth``."""
+    leaves = [_STRING, _NUMBER, _BOOL, _NULL]
+    if depth <= 0:
+        return alt(*leaves)
+    inner = _json_value(depth - 1)
+    obj = seq(
+        lit(b"{"), _WS,
+        opt(seq(
+            _STRING, _WS, lit(b":"), _WS, inner,
+            star(seq(_WS, lit(b","), _WS, _STRING, _WS, lit(b":"), _WS, inner)),
+        )),
+        _WS, lit(b"}"),
+    )
+    arr = seq(
+        lit(b"["), _WS,
+        opt(seq(inner, star(seq(_WS, lit(b","), _WS, inner)))),
+        _WS, lit(b"]"),
+    )
+    return alt(*leaves, obj, arr)
+
+
+def schema_to_regex(schema: dict[str, Any] | None, depth: int = 4) -> Node:
+    """JSON-schema subset → regex. Supported: type object (properties in
+    declaration order, all listed properties required), string, number,
+    integer, boolean, null, array (items), enum (of strings), and {} / None
+    meaning "any JSON value"."""
+    if not schema:
+        return _json_value(depth)
+    if "enum" in schema:
+        opts = [text(json_quote(v)) for v in schema["enum"]]
+        return alt(*opts)
+    t = schema.get("type")
+    if t == "object" or (t is None and "properties" in schema):
+        props = schema.get("properties", {})
+        if not props:
+            return _json_value(depth)
+        parts: list[Node] = [lit(b"{"), _WS]
+        for i, (key, sub) in enumerate(props.items()):
+            if i:
+                parts += [_WS, lit(b","), _WS]
+            parts += [
+                text(f'"{key}"'), _WS, lit(b":"), _WS,
+                schema_to_regex(sub, depth - 1),
+            ]
+        parts += [_WS, lit(b"}")]
+        return seq(*parts)
+    if t == "array":
+        inner = schema_to_regex(schema.get("items"), depth - 1)
+        return seq(
+            lit(b"["), _WS,
+            opt(seq(inner, star(seq(_WS, lit(b","), _WS, inner)))),
+            _WS, lit(b"]"),
+        )
+    if t == "string":
+        return _STRING
+    if t in ("number", "integer"):
+        return _NUMBER
+    if t == "boolean":
+        return _BOOL
+    if t == "null":
+        return _NULL
+    return _json_value(depth)
+
+
+def json_quote(value: Any) -> str:
+    import json
+
+    return json.dumps(value)
+
+
+# -- Token-level FSM --------------------------------------------------------
+class TokenFSM:
+    """Lifts a byte DFA to token-level masks over a tokenizer vocabulary.
+
+    Masks are computed lazily per DFA state and cached — but each state's
+    mask is VECTORIZED over the vocabulary (token bytes packed into a dense
+    [vocab, maxlen] matrix, advanced one byte-position per numpy op), so a
+    cold state costs milliseconds even at 128k tokens. That matters because
+    the engine asks for masks while holding its dispatch lock. The C++
+    matcher in ``opsagent_tpu.native`` precomputes the same tables eagerly."""
+
+    def __init__(self, dfa: ByteDFA, token_bytes: list[bytes], eos_id: int):
+        self.dfa = dfa
+        self.token_bytes = token_bytes
+        self.eos_id = eos_id
+        self.vocab_size = len(token_bytes)
+        self._mask_cache: dict[int, np.ndarray] = {}
+        self._lens = np.array([len(tb) for tb in token_bytes], np.int32)
+        maxlen = max(1, int(self._lens.max()))
+        self._bytes = np.zeros((self.vocab_size, maxlen), np.int32)
+        for tid, tb in enumerate(token_bytes):
+            if tb:
+                self._bytes[tid, : len(tb)] = np.frombuffer(tb, np.uint8)
+
+    def mask_for_state(self, state: int) -> np.ndarray:
+        cached = self._mask_cache.get(state)
+        if cached is not None:
+            return cached
+        mask = np.zeros((self.vocab_size,), bool)
+        if state >= 0:
+            nxt = self.dfa.next
+            st = np.full((self.vocab_size,), state, np.int32)
+            alive = self._lens > 0  # empty byte-strings (specials) forbidden
+            for j in range(self._bytes.shape[1]):
+                has = j < self._lens
+                step = alive & has
+                idx = np.where(step, st, 0) * 256 + self._bytes[:, j]
+                st = np.where(step, nxt[idx], st)
+                alive &= ~has | (st >= 0)
+            mask = alive
+            if self.dfa.accept[state]:
+                mask[self.eos_id] = True
+        self._mask_cache[state] = mask
+        return mask
+
+    def advance(self, state: int, token_id: int) -> int:
+        return self.dfa.run(state, self.token_bytes[token_id])
+
+
+class JsonConstraint:
+    """Engine-facing ``mask_fn``: tracks DFA state incrementally across the
+    generated-token list the engine passes each step."""
+
+    def __init__(self, fsm: TokenFSM):
+        self.fsm = fsm
+        self._state = fsm.dfa.start
+        self._consumed = 0
+
+    def __call__(self, tokens: list[int]) -> np.ndarray:
+        if len(tokens) < self._consumed:  # new sequence reusing the object
+            self._state, self._consumed = self.fsm.dfa.start, 0
+        for tok in tokens[self._consumed:]:
+            if tok != self.fsm.eos_id:
+                self._state = self.fsm.advance(self._state, tok)
+        self._consumed = len(tokens)
+        return self.fsm.mask_for_state(self._state)
+
+
+def json_constraint(
+    tokenizer,
+    schema: dict[str, Any] | None = None,
+    depth: int = 4,
+) -> JsonConstraint:
+    """Build a fresh per-request constraint; the underlying TokenFSM is
+    cached per (schema, depth) ON the tokenizer object itself, so the cache
+    dies with its tokenizer (a global keyed on id() could go stale when
+    CPython reuses a freed object's address)."""
+    import json
+
+    cache = tokenizer.__dict__.setdefault("_fsm_cache", {})
+    key = (json.dumps(schema, sort_keys=True), depth)
+    fsm = cache.get(key)
+    if fsm is None:
+        dfa = compile_regex(schema_to_regex(schema, depth))
+        tb = [tokenizer.token_bytes(t) for t in range(tokenizer.vocab_size)]
+        fsm = TokenFSM(dfa, tb, tokenizer.eos_id)
+        cache[key] = fsm
+    return JsonConstraint(fsm)
+
+
+# The ReAct wire format the agent loop speaks (reference pkg/tools/tool.go:29-38).
+TOOLPROMPT_SCHEMA: dict[str, Any] = {
+    "type": "object",
+    "properties": {
+        "question": {"type": "string"},
+        "thought": {"type": "string"},
+        "action": {
+            "type": "object",
+            "properties": {
+                "name": {"type": "string"},
+                "input": {"type": "string"},
+            },
+        },
+        "observation": {"type": "string"},
+        "final_answer": {"type": "string"},
+    },
+}

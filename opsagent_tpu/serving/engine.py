@@ -214,7 +214,13 @@ class Engine:
             if s.mask_fn is not None:
                 if mask is None:
                     mask = np.ones((B, self.model_cfg.vocab_size), bool)
-                mask[i] = s.mask_fn(s.tokens)
+                m = s.mask_fn(s.tokens)
+                # Checkpoints pad the embedding vocab past the tokenizer's
+                # (e.g. Qwen 152064 vs ~151.7k): padded ids are forbidden on
+                # constrained rows — the tokenizer could never decode them.
+                n = min(len(m), mask.shape[1])
+                mask[i, :n] = m[:n]
+                mask[i, n:] = False
         self._sample_key, sub = jax.random.split(self._sample_key)
         tok = self._sample_jit(
             logits,

@@ -23,6 +23,7 @@ class Tokenizer(Protocol):
     def encode(self, text: str) -> list[int]: ...
     def decode(self, ids: list[int]) -> str: ...
     def token_str(self, token_id: int) -> str: ...
+    def token_bytes(self, token_id: int) -> bytes: ...
 
 
 class ByteTokenizer:
@@ -51,6 +52,13 @@ class ByteTokenizer:
             return chr(token_id) if token_id < 128 else ""
         return ""
 
+    def token_bytes(self, token_id: int) -> bytes:
+        """Raw bytes this token contributes to the output stream (empty for
+        special tokens — they never satisfy a constrained-decoding FSM)."""
+        if 0 <= token_id < 256:
+            return bytes([token_id])
+        return b""
+
 
 class HFTokenizer:
     """Local HuggingFace tokenizer wrapper (no network access)."""
@@ -67,6 +75,7 @@ class HFTokenizer:
             if self._tok.pad_token_id is not None
             else self.eos_id
         )
+        self._special_ids: set[int] | None = None  # filled on first use
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=False)
@@ -77,9 +86,55 @@ class HFTokenizer:
     def token_str(self, token_id: int) -> str:
         return self._tok.convert_ids_to_tokens(token_id) or ""
 
+    def token_bytes(self, token_id: int) -> bytes:
+        """Raw bytes of one token, undoing byte-level-BPE's printable-char
+        remapping (the GPT-2 byte encoder used by Llama-3/Qwen/DeepSeek
+        vocabularies). Special tokens map to b""."""
+        if self._special_ids is None:
+            self._special_ids = set(self._tok.all_special_ids)
+        if token_id in self._special_ids:
+            return b""
+        tok = self._tok.convert_ids_to_tokens(token_id)
+        if tok is None:
+            return b""
+        dec = _byte_decoder()
+        if all(c in dec for c in tok):
+            return bytes(dec[c] for c in tok)
+        # SentencePiece-style vocab: '<0xNN>' byte-fallback tokens ARE the
+        # byte they name; '▁' marks a leading space.
+        if len(tok) == 6 and tok.startswith("<0x") and tok.endswith(">"):
+            try:
+                return bytes([int(tok[3:5], 16)])
+            except ValueError:
+                pass
+        return tok.replace("▁", " ").encode("utf-8")
+
     @property
     def hf(self):  # escape hatch for chat templates
         return self._tok
+
+
+_BYTE_DECODER: dict[str, int] | None = None
+
+
+def _byte_decoder() -> dict[str, int]:
+    """char -> byte map inverting the GPT-2 byte-to-unicode encoder."""
+    global _BYTE_DECODER
+    if _BYTE_DECODER is None:
+        bs = (
+            list(range(ord("!"), ord("~") + 1))
+            + list(range(0xA1, 0xAD))
+            + list(range(0xAE, 0x100))
+        )
+        cs = bs[:]
+        n = 0
+        for b in range(256):
+            if b not in bs:
+                bs.append(b)
+                cs.append(256 + n)
+                n += 1
+        _BYTE_DECODER = {chr(c): b for b, c in zip(bs, cs)}
+    return _BYTE_DECODER
 
 
 def load_tokenizer(path: str = "", vocab_size: int = 512) -> Tokenizer:

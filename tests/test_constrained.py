@@ -1,0 +1,194 @@
+"""Constrained decoding: regex→DFA compilation, schema masks, engine wiring.
+
+The reference survives malformed LLM JSON with a repair ladder
+(pkg/utils/json.go); here we assert malformed JSON is unrepresentable: every
+token the mask admits keeps the output inside the schema's language.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from opsagent_tpu.serving.constrained import (
+    TOOLPROMPT_SCHEMA,
+    ByteDFA,
+    JsonConstraint,
+    compile_regex,
+    json_constraint,
+    schema_to_regex,
+)
+from opsagent_tpu.serving.tokenizer import ByteTokenizer
+
+
+def accepts(dfa: ByteDFA, s: str) -> bool:
+    state = dfa.run(dfa.start, s.encode("utf-8"))
+    return state >= 0 and bool(dfa.accept[state])
+
+
+def prefix_ok(dfa: ByteDFA, s: str) -> bool:
+    return dfa.run(dfa.start, s.encode("utf-8")) >= 0
+
+
+class TestGenericJson:
+    @pytest.fixture(scope="class")
+    def dfa(self):
+        return compile_regex(schema_to_regex(None, depth=3))
+
+    @pytest.mark.parametrize("doc", [
+        '"hello"', "42", "-3.5e2", "true", "false", "null",
+        '{"a": 1}', '{"a": {"b": [1, 2, 3]}}', "[]", '[{"x": "y"}]',
+        '{"s": "with \\"escape\\" and \\u00e9"}', '{ "spaced" : [ 1 , 2 ] }',
+    ])
+    def test_accepts_valid(self, dfa, doc):
+        json.loads(doc)  # sanity: it really is JSON
+        assert accepts(dfa, doc)
+
+    @pytest.mark.parametrize("doc", [
+        "{", '{"a" 1}', '{"a": 1,}', "[1, ]", "tru", '"unterminated',
+        "01", "+1", '{"a": }', "nope",
+    ])
+    def test_rejects_invalid(self, dfa, doc):
+        assert not accepts(dfa, doc)
+
+    def test_prefixes_live(self, dfa):
+        # Every prefix of a valid doc must be a live DFA state (else the
+        # mask would dead-end generation mid-output).
+        doc = '{"key": [1, {"n": -2.5}], "t": true}'
+        for i in range(len(doc)):
+            assert prefix_ok(dfa, doc[:i]), doc[:i]
+
+
+class TestToolPromptSchema:
+    @pytest.fixture(scope="class")
+    def dfa(self):
+        return compile_regex(schema_to_regex(TOOLPROMPT_SCHEMA))
+
+    def test_accepts_wire_format(self, dfa):
+        doc = json.dumps({
+            "question": "count namespaces",
+            "thought": "list then count",
+            "action": {"name": "kubectl", "input": "kubectl get ns | wc -l"},
+            "observation": "",
+            "final_answer": "",
+        })
+        assert accepts(dfa, doc)
+
+    def test_rejects_wrong_keys_and_types(self, dfa):
+        assert not accepts(dfa, json.dumps({"question": "q"}))
+        assert not accepts(dfa, json.dumps({
+            "question": 1, "thought": "t",
+            "action": {"name": "n", "input": "i"},
+            "observation": "o", "final_answer": "f",
+        }))
+
+
+class TestTokenMasking:
+    def test_mask_admits_only_live_tokens(self):
+        tok = ByteTokenizer()
+        c = json_constraint(tok, None, depth=2)
+        mask = c([])  # start state
+        assert mask[ord("{")] and mask[ord('"')] and mask[ord("1")]
+        assert not mask[ord("}")] and not mask[ord(",")]
+        assert not mask[tok.eos_id]  # empty string is not JSON
+
+        toks = list(b'{"a": 1')
+        mask = c(toks)
+        assert mask[ord("}")] and mask[ord("0")] and mask[ord(",")]
+        assert not mask[ord("{")]
+        toks += [ord("}")]
+        mask = c(toks)
+        assert mask[tok.eos_id]  # complete document: EOS admissible
+
+    def test_incremental_state_tracking(self):
+        tok = ByteTokenizer()
+        c = json_constraint(tok, {"type": "boolean"})
+        assert c([])[ord("t")] and c([])[ord("f")]
+        m = c(list(b"tr"))
+        assert m[ord("u")] and not m[ord("a")]
+        m = c(list(b"true"))
+        assert m[tok.eos_id]
+        assert not m.any() or m.sum() == 1  # only EOS from the accept state
+
+    def test_greedy_generation_yields_valid_json(self):
+        """Drive the mask against a hostile 'model' that always proposes the
+        lowest-id admissible token: the result must still parse."""
+        tok = ByteTokenizer()
+        c = json_constraint(tok, TOOLPROMPT_SCHEMA)
+        out: list[int] = []
+        # Prefer structure-closing bytes so the walk terminates; otherwise
+        # the lowest admissible non-whitespace byte (a hostile-but-finite
+        # policy: any admissible choice must stay inside the language).
+        prefer = [ord(c_) for c_ in '"}]:,']
+        ws = {9, 10, 13, 32}
+        for _ in range(300):
+            mask = c(out)
+            if mask[tok.eos_id]:
+                break
+            ids = np.flatnonzero(mask)
+            assert len(ids), "mask dead-ended"
+            pick = next((p for p in prefer if p < len(mask) and mask[p]), None)
+            if pick is None:
+                pick = int(next(i for i in ids if int(i) not in ws))
+            out.append(int(pick))
+        doc = bytes(t for t in out if t < 256).decode()
+        parsed = json.loads(doc)
+        assert set(parsed) == {
+            "question", "thought", "action", "observation", "final_answer"
+        }
+
+
+class TestEngineWiring:
+    def test_response_format_constrains_engine_output(self):
+        """tiny-test engine with random weights + json_object response_format
+        must emit valid JSON (the whole point: garbage weights, valid wire)."""
+        import jax.numpy as jnp
+
+        from opsagent_tpu.serving.api import ServingStack
+        from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+        eng = Engine(EngineConfig(
+            model="tiny-test", dtype=jnp.float32, num_pages=64, page_size=8,
+            max_pages_per_seq=16, max_batch_size=2, prefill_buckets=(32, 64),
+        ))
+        stack = ServingStack(eng)
+        try:
+            resp = stack.chat_completion({
+                "messages": [{"role": "user", "content": "emit json"}],
+                "max_tokens": 64,
+                "temperature": 1.0,
+                "response_format": {"type": "json_object"},
+            })
+            text = resp["choices"][0]["message"]["content"]
+            if resp["choices"][0]["finish_reason"] == "stop":
+                json.loads(text)  # complete → must parse
+            else:  # length-capped: still a valid JSON prefix (live DFA state)
+                from opsagent_tpu.serving.constrained import (
+                    compile_regex, schema_to_regex,
+                )
+                dfa = compile_regex(schema_to_regex(None))
+                assert dfa.run(dfa.start, text.encode()) >= 0
+        finally:
+            stack.close()
+
+    def test_bad_response_format_is_400(self):
+        import jax.numpy as jnp
+
+        from opsagent_tpu.serving.api import ServingStack
+        from opsagent_tpu.serving.engine import Engine, EngineConfig
+        from opsagent_tpu.serving.scheduler import RequestError
+
+        eng = Engine(EngineConfig(
+            model="tiny-test", dtype=jnp.float32, num_pages=32, page_size=8,
+            max_pages_per_seq=8, max_batch_size=2, prefill_buckets=(32,),
+        ))
+        stack = ServingStack(eng)
+        try:
+            with pytest.raises(RequestError) as ei:
+                stack.chat_completion({
+                    "messages": [{"role": "user", "content": "x"}],
+                    "response_format": {"type": "yaml"},
+                })
+            assert ei.value.status == 400
+        finally:
+            stack.close()

@@ -161,24 +161,60 @@ def test_http_completions_and_stream(stack):
     asyncio.get_event_loop_policy().new_event_loop().run_until_complete(scenario())
 
 
-def test_agent_over_tpu_provider_end_to_end(stack, fake_tools):
+def test_agent_over_tpu_provider_end_to_end(fake_tools):
     """The reference's whole raison d'être, in-tree: the ReAct agent loop
     running against the TPU engine through the tpu:// scheme — zero external
-    API calls. With random tiny weights the model emits non-JSON, which the
-    loop's first-reply fallback returns as the final answer; the transcript
-    proves the full path agent -> provider -> engine -> sampler -> detokenize."""
-    from opsagent_tpu.agent.react import assistant_with_config
+    API calls. The loop requests schema-constrained decoding, so even random
+    tiny weights emit parseable ToolPrompt JSON: every assistant turn in the
+    transcript must parse, proving agent -> provider -> engine -> FSM-masked
+    sampler -> detokenize end to end."""
+    import json as _json
 
-    fake_tools({})
-    messages = [
-        {"role": "system", "content": "you are a test agent"},
-        {"role": "user", "content": "count namespaces"},
-    ]
-    out, history = assistant_with_config(
-        "tpu://tiny-test", messages, max_tokens=4, max_iterations=2
+    from opsagent_tpu.agent.react import assistant_with_config
+    from opsagent_tpu.serving.api import ServingStack, install_stack, _stacks
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    cfg = EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+        num_pages=256, max_pages_per_seq=128, max_batch_size=2,
+        prefill_buckets=(256, 512, 1024), max_new_tokens_default=48,
     )
-    assert isinstance(out, str)
-    assert history[-1]["role"] == "assistant"
+    s = ServingStack(Engine(cfg))
+    install_stack("tiny-agent", s)
+    try:
+        fake_tools({})
+        messages = [
+            {"role": "system", "content": "you are a test agent"},
+            {"role": "user", "content": "count namespaces"},
+        ]
+        out, history = assistant_with_config(
+            "tpu://tiny-agent", messages, max_tokens=48, max_iterations=2
+        )
+        assert isinstance(out, str)
+        assert history[-1]["role"] == "assistant"
+        # Constrained decoding guarantees every emitted byte stays inside
+        # the ToolPrompt schema's language: a completed reply parses, a
+        # length-capped one is still a valid prefix (live DFA state).
+        from opsagent_tpu.serving.constrained import (
+            TOOLPROMPT_SCHEMA, compile_regex, schema_to_regex,
+        )
+
+        dfa = compile_regex(schema_to_regex(TOOLPROMPT_SCHEMA))
+        for msg in history:
+            if msg["role"] == "assistant":
+                state = dfa.run(dfa.start, msg["content"].encode())
+                assert state >= 0, f"escaped the schema: {msg['content']!r}"
+                try:
+                    parsed = _json.loads(msg["content"])
+                    assert set(parsed) <= {
+                        "question", "thought", "action", "observation",
+                        "final_answer",
+                    }
+                except _json.JSONDecodeError:
+                    assert not dfa.accept[state]  # truncated, not malformed
+    finally:
+        s.close()
+        _stacks.pop("tiny-agent", None)
 
 
 def test_prompt_too_long_fails_fast(stack):
