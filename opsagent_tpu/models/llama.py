@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops.attention import (
     causal_prefill_attention,
     paged_decode_attention_auto,
+    paged_prefix_attention,
     write_kv_pages,
 )
 from ..ops.rope import apply_rope, rope_table
@@ -193,6 +194,53 @@ def prefill(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.clip(lengths - 1, 0, S - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
+    logits = _lm_head(params, cfg, x_last)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def prefill_with_prefix(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B, S] int32 TAIL tokens, right-padded
+    start: jax.Array,        # [B] cached-prefix lengths
+    lengths: jax.Array,      # [B] valid tail lengths
+    cache: Params,
+    page_table: jax.Array,   # [B, MaxP] (prefix pages + fresh tail pages)
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jax.Array, Params]:
+    """Prefix-cache admission: forward only the tail, attending over the
+    sequence's cached prefix pages + the tail KV written this call. Returns
+    (last-tail-position logits [B, V], updated cache). With start=0 this is
+    semantically ``prefill`` (kept separate so the no-prefix program avoids
+    the page gather)."""
+    B, S = tokens.shape
+    positions = start[:, None] + jnp.arange(S)[None, :]
+    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+    x = params["embed"][tokens].astype(dtype)
+
+    def body(x, scanned):
+        lp, k_pages, v_pages = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(h, lp, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_pages, v_pages = write_kv_pages(
+            k_pages, v_pages, k, v, page_table, start, valid_len=lengths
+        )
+        attn = paged_prefix_attention(
+            q, k_pages, v_pages, page_table, start, lengths
+        )
+        x = x + attn.reshape(B, S, -1) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h, lp)
+        return x, (k_pages, v_pages)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.clip(lengths - 1, 0, S - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, cfg, x_last)
     return logits, {"k": k_new, "v": v_new}
 

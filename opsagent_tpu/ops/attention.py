@@ -130,6 +130,48 @@ def write_kv_pages(
     return kf.reshape(N, P, K, D), vf.reshape(N, P, K, D)
 
 
+def paged_prefix_attention(
+    q: jax.Array,           # [B, S, H, D] tail queries (right-padded)
+    k_pages: jax.Array,     # [N, P, K, D]
+    v_pages: jax.Array,     # [N, P, K, D]
+    page_table: jax.Array,  # [B, MaxP]
+    start: jax.Array,       # [B] cached-prefix lengths (tail begins here)
+    lengths: jax.Array,     # [B] valid TAIL lengths
+) -> jax.Array:
+    """Tail-prefill attention over paged KV holding [prefix + tail].
+
+    The prefix-cache admission path: the tail's fresh K/V has already been
+    written into pages at offset ``start``; tail query s attends causally to
+    every cached position t <= start + s. Gather-based XLA reference (the
+    Pallas flash variant can come later — admission is not the steady-state
+    hot loop the way decode is)."""
+    N, P, K, D = k_pages.shape
+    B, S, H, _ = q.shape
+    G = H // K
+    MaxP = page_table.shape[1]
+    L = MaxP * P
+    scale = 1.0 / (D ** 0.5)
+    safe_table = jnp.clip(page_table, 0, N - 1)
+    k_seq = k_pages[safe_table].reshape(B, L, K, D)
+    v_seq = v_pages[safe_table].reshape(B, L, K, D)
+    qg = q.reshape(B, S, K, G, D)
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k_seq, preferred_element_type=jnp.float32
+    ) * scale
+    pos_t = jnp.arange(L)[None, None, :]                   # [1, 1, L]
+    pos_q = (start[:, None] + jnp.arange(S)[None, :])[:, :, None]  # [B, S, 1]
+    mask = (pos_t <= pos_q) & (pos_t < (start + lengths)[:, None, None])
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgst,btkd->bskgd",
+        probs.astype(v_seq.dtype),
+        v_seq,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
 def paged_decode_attention(
     q: jax.Array,           # [B, H, D] (one new token per sequence)
     k_pages: jax.Array,     # [N, P, K, D]

@@ -76,7 +76,15 @@ class ServingStack:
             spec = rf.get("json_schema") or {}
             if not isinstance(spec, dict):
                 raise ValueError("response_format.json_schema must be an object")
-            schema = spec.get("schema", spec if "properties" in spec else {})
+            if "schema" in spec:
+                schema = spec["schema"]
+            elif any(k in spec for k in ("type", "properties", "enum", "items")):
+                schema = spec  # schema passed bare, not nested under "schema"
+            else:
+                raise ValueError(
+                    "response_format.json_schema carries no schema "
+                    '(expected a "schema" member or an inline JSON schema)'
+                )
             if not isinstance(schema, dict):
                 raise ValueError("json_schema.schema must be an object")
             return json_constraint(self.engine.tokenizer, schema or None)
@@ -351,6 +359,8 @@ def build_engine_app(stack: ServingStack):
                 "model": stack.model_name,
                 "free_pages": eng.alloc.free_pages,
                 "running": len(eng.sequences),
+                "prefix_hit_tokens": eng.alloc.hit_tokens,
+                "prefix_miss_tokens": eng.alloc.miss_tokens,
             }
         )
 

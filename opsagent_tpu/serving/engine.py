@@ -53,6 +53,7 @@ class EngineConfig:
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
     max_new_tokens_default: int = 1024
     seed: int = 0
+    prefix_cache: bool = True
 
 
 @dataclass
@@ -61,6 +62,7 @@ class Sequence:
 
     seq_id: int
     prompt_len: int
+    prompt_ids: list[int] = field(default_factory=list)
     tokens: list[int] = field(default_factory=list)   # generated tokens
     params: SamplingParams = field(default_factory=SamplingParams)
     done: bool = False
@@ -114,7 +116,10 @@ class Engine:
             self.model_cfg, cfg.num_pages, cfg.page_size, dtype=cfg.dtype
         )
         self.cache = shard_params(cache, llama.cache_specs(self.model_cfg), self.mesh)
-        self.alloc = PageAllocator(cfg.num_pages, cfg.page_size, cfg.max_pages_per_seq)
+        self.alloc = PageAllocator(
+            cfg.num_pages, cfg.page_size, cfg.max_pages_per_seq,
+            prefix_cache=cfg.prefix_cache,
+        )
         self.sequences: dict[int, Sequence] = {}
         self._sample_key = jax.random.PRNGKey(cfg.seed + 1)
 
@@ -127,6 +132,11 @@ class Engine:
         def _prefill(params, tokens, lengths, cache, table):
             return llama.prefill(params, mc, tokens, lengths, cache, table, dtype=dt)
 
+        def _prefill_prefix(params, tokens, start, lengths, cache, table):
+            return llama.prefill_with_prefix(
+                params, mc, tokens, start, lengths, cache, table, dtype=dt
+            )
+
         def _decode(params, tokens, lengths, cache, table, active):
             return llama.decode_step(
                 params, mc, tokens, lengths, cache, table, active, dtype=dt,
@@ -134,20 +144,22 @@ class Engine:
             )
 
         self._prefill_jit = jax.jit(_prefill, donate_argnames=("cache",))
+        self._prefill_prefix_jit = jax.jit(
+            _prefill_prefix, donate_argnames=("cache",)
+        )
         self._decode_jit = jax.jit(_decode, donate_argnames=("cache",))
         self._sample_jit = jax.jit(sample)
 
     # -- bucketing ---------------------------------------------------------
     def _bucket(self, n: int) -> int:
+        """Smallest prefill bucket holding n tokens. Tails longer than the
+        largest bucket are CHUNKED through it by add_request, so bucket
+        choice never rejects a prompt — only the page budget
+        (max_pages_per_seq) bounds prompt length."""
         for b in self.cfg.prefill_buckets:
             if n <= b:
                 return b
-        from .kvcache import PromptTooLong
-
-        raise PromptTooLong(
-            f"prompt of {n} tokens exceeds the largest prefill bucket "
-            f"{self.cfg.prefill_buckets[-1]}"
-        )
+        return self.cfg.prefill_buckets[-1]
 
     # -- request lifecycle -------------------------------------------------
     def add_request(
@@ -166,28 +178,28 @@ class Engine:
         with self.lock:
             perf = get_perf_stats()
             t0 = time.perf_counter()
-            bucket = self._bucket(n)  # raises PromptTooLong BEFORE allocating
-            seq_id = self.alloc.allocate(n)
+            # Prefix cache: reuse full pages of the prompt MINUS its last
+            # token (at least one tail token must be prefilled to produce
+            # the next-token logits).
+            prefix_pages = self.alloc.match_prefix(prompt_ids[: n - 1])
+            matched = len(prefix_pages) * self.cfg.page_size
+            seq_id = self.alloc.allocate(n, prefix_pages=prefix_pages)
             try:
                 seq = Sequence(
-                    seq_id, n, params=sampling, mask_fn=mask_fn, stream=stream
+                    seq_id, n, prompt_ids=list(prompt_ids),
+                    params=sampling, mask_fn=mask_fn, stream=stream,
                 )
                 self.sequences[seq_id] = seq
-                tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-                tokens[0, :n] = prompt_ids
                 table = self.alloc.page_table_row(seq_id)[None, :]
-                with self.mesh:
-                    logits, self.cache = self._prefill_jit(
-                        self.params,
-                        jnp.asarray(tokens),
-                        jnp.asarray([n], jnp.int32),
-                        self.cache,
-                        jnp.asarray(table),
-                    )
+                logits = self._prefill_chunked(
+                    prompt_ids, matched, jnp.asarray(table)
+                )
                 token = int(self._sample_one(logits, [seq])[0])
                 seq.ttft_s = time.perf_counter() - t0
                 perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
-                perf.record_metric("engine.prefill_tokens", n, "tok")
+                perf.record_metric("engine.prefill_tokens", n - matched, "tok")
+                if matched:
+                    perf.record_metric("engine.prefix_hit_tokens", matched, "tok")
                 self._accept_token(seq, token)
             except Exception:
                 # Failed admissions (prefill OOM, raising mask_fn, a raising
@@ -198,6 +210,45 @@ class Engine:
                 self.alloc.free(seq_id)
                 raise
             return seq_id
+
+    def _prefill_chunked(
+        self, prompt_ids: list[int], matched: int, table: jax.Array
+    ) -> jax.Array:
+        """Prefill everything past ``matched`` in bucket-sized chunks, each
+        chunk attending over all cache content before it (the prefix pages
+        plus previously prefilled chunks). Returns the last position's
+        logits. Chunking keeps admission independent of prefix-cache state:
+        a prompt longer than the largest bucket still prefills — the same
+        XLA programs, run ceil(tail/bucket) times."""
+        n = len(prompt_ids)
+        biggest = self.cfg.prefill_buckets[-1]
+        done = matched
+        logits = None
+        with self.mesh:
+            while done < n:
+                chunk = min(n - done, biggest)
+                bucket = self._bucket(chunk)
+                tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+                tokens[0, :chunk] = prompt_ids[done:done + chunk]
+                if done:
+                    logits, self.cache = self._prefill_prefix_jit(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray([done], jnp.int32),
+                        jnp.asarray([chunk], jnp.int32),
+                        self.cache,
+                        table,
+                    )
+                else:
+                    logits, self.cache = self._prefill_jit(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray([chunk], jnp.int32),
+                        self.cache,
+                        table,
+                    )
+                done += chunk
+        return logits
 
     def _sample_one(self, logits: jax.Array, seqs: list[Sequence]) -> np.ndarray:
         B = logits.shape[0]
@@ -316,10 +367,13 @@ class Engine:
             return out
 
     def finish(self, seq_id: int) -> list[int]:
-        """Release resources; returns the generated tokens."""
+        """Release resources; returns the generated tokens. Full pages are
+        donated to the prefix trie keyed by their exact token history (the
+        cache holds prompt + generated[:-1]: the last sampled token is never
+        written back by a decode step)."""
         with self.lock:
             seq = self.sequences.pop(seq_id)
-            self.alloc.free(seq_id)
+            self.alloc.free(seq_id, tokens=seq.prompt_ids + seq.tokens[:-1])
             return seq.tokens
 
     # -- convenience (tests / bench) ----------------------------------------
