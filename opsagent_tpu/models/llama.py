@@ -1,16 +1,22 @@
-"""Llama-family decoder (Llama-3, Qwen2.5): GQA + RoPE + SwiGLU + RMSNorm.
+"""Llama-family decoder (Llama-3, Qwen2.5, DeepSeek-MoE): GQA + RoPE +
+SwiGLU + RMSNorm, with optional mixture-of-experts MLP layers.
 
 Functional JAX, designed for XLA/TPU:
 
 - Parameters are a pytree of **stacked** per-layer arrays (leading dim = num
   layers) walked with ``lax.scan`` — one traced layer body instead of L
-  inlined copies, which keeps 80-layer compile times sane.
+  inlined copies, which keeps 80-layer compile times sane. MoE models run
+  TWO stacks back to back: the dense head (``moe_layer_start`` layers) and
+  the MoE tail, each its own scan over uniform params.
 - Tensor parallelism is pure sharding metadata: ``param_specs`` returns a
   matching pytree of PartitionSpecs (Megatron-style column/row splits over
   the "tp" mesh axis); XLA inserts the all-reduces at wo/wd boundaries.
-- Two entry points over the same weights: ``prefill`` (causal attention over
-  the fresh sequence, writes KV pages) and ``decode_step`` (one token per
-  sequence, paged attention) — the two XLA programs the serving engine jits.
+  Expert weights shard their inner (intermediate) dim over tp the same way,
+  so one mesh serves dense and MoE checkpoints alike.
+- Entry points over the same weights: ``prefill`` (causal attention over the
+  fresh sequence, writes KV pages), ``prefill_with_prefix`` (tail admission
+  against cached prefix pages), ``decode_step`` (one token per sequence,
+  paged attention), ``forward_full`` (all-positions oracle / training loss).
 
 This whole module replaces the reference's outbound HTTPS call to a remote
 LLM (reference pkg/llms/openai.go:69-103); there is no counterpart Go code.
@@ -18,7 +24,7 @@ LLM (reference pkg/llms/openai.go:69-103); there is no counterpart Go code.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -36,70 +42,147 @@ from .config import ModelConfig
 Params = dict[str, Any]
 
 
+def _layer_split(cfg: ModelConfig) -> tuple[int, int]:
+    """(dense layer count, MoE layer count)."""
+    if cfg.moe is None:
+        return cfg.num_layers, 0
+    return cfg.moe_layer_start, cfg.num_layers - cfg.moe_layer_start
+
+
 # -- init / specs -----------------------------------------------------------
-def init_params(
-    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
-) -> Params:
-    """Random init (scaled normal). Real checkpoints come via models.loader."""
-    d, f, v, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
-    q, kv = cfg.q_size, cfg.kv_size
-    ks = iter(jax.random.split(key, 12))
+def _norm01(k, shape, fan_in, dtype):
+    return (
+        jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)
+    ).astype(dtype)
+
+
+def _init_attn_block(ks, cfg: ModelConfig, L: int, dtype) -> Params:
+    d, q, kv = cfg.hidden_size, cfg.q_size, cfg.kv_size
 
     def norm01(k, shape, fan_in):
-        return (jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+        return _norm01(k, shape, fan_in, dtype)
 
-    layers: Params = {
+    block: Params = {
         "attn_norm": jnp.ones((L, d), dtype),
         "wq": norm01(next(ks), (L, d, q), d),
         "wk": norm01(next(ks), (L, d, kv), d),
         "wv": norm01(next(ks), (L, d, kv), d),
         "wo": norm01(next(ks), (L, q, d), q),
         "mlp_norm": jnp.ones((L, d), dtype),
-        "wg": norm01(next(ks), (L, d, f), d),
-        "wu": norm01(next(ks), (L, d, f), d),
-        "wd": norm01(next(ks), (L, f, d), f),
     }
     if cfg.attn_bias:
-        layers["bq"] = jnp.zeros((L, q), dtype)
-        layers["bk"] = jnp.zeros((L, kv), dtype)
-        layers["bv"] = jnp.zeros((L, kv), dtype)
+        block["bq"] = jnp.zeros((L, q), dtype)
+        block["bk"] = jnp.zeros((L, kv), dtype)
+        block["bv"] = jnp.zeros((L, kv), dtype)
+    return block
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random init (scaled normal). Real checkpoints come via models.loader."""
+    d, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    Ld, Lm = _layer_split(cfg)
+    ks = iter(jax.random.split(key, 32))
+
+    def norm01(k, shape, fan_in):
+        return _norm01(k, shape, fan_in, dtype)
+
+    layers = _init_attn_block(ks, cfg, Ld, dtype)
+    layers.update(
+        {
+            "wg": norm01(next(ks), (Ld, d, f), d),
+            "wu": norm01(next(ks), (Ld, d, f), d),
+            "wd": norm01(next(ks), (Ld, f, d), f),
+        }
+    )
     params: Params = {
         "embed": norm01(next(ks), (v, d), d),
         "layers": layers,
         "final_norm": jnp.ones((d,), dtype),
     }
+    if Lm:
+        m = cfg.moe
+        fe = m.expert_intermediate_size or f
+        E = m.num_experts
+        moe_layers = _init_attn_block(ks, cfg, Lm, dtype)
+        moe_layers.update(
+            {
+                # Router stays f32: tiny, and top-k is precision-sensitive.
+                "router": jax.random.normal(
+                    next(ks), (Lm, d, E), jnp.float32
+                ) * (d ** -0.5),
+                "eg": norm01(next(ks), (Lm, E, d, fe), d),
+                "eu": norm01(next(ks), (Lm, E, d, fe), d),
+                "ed": norm01(next(ks), (Lm, E, fe, d), fe),
+            }
+        )
+        if m.num_shared_experts:
+            fs = fe * m.num_shared_experts
+            moe_layers["sg"] = norm01(next(ks), (Lm, d, fs), d)
+            moe_layers["su"] = norm01(next(ks), (Lm, d, fs), d)
+            moe_layers["sd"] = norm01(next(ks), (Lm, fs, d), fs)
+        params["moe_layers"] = moe_layers
     if not cfg.tie_embeddings:
         params["lm_head"] = norm01(next(ks), (d, v), d)
     return params
 
 
-def param_specs(cfg: ModelConfig) -> Params:
-    """PartitionSpecs matching ``init_params``' tree (axes: ("dp","sp","tp")).
-
-    Column-parallel: wq/wk/wv/wg/wu (output dim over tp). Row-parallel:
-    wo/wd (input dim over tp, XLA all-reduces the partial sums). Embedding
-    sharded over vocab; lm_head over vocab columns.
-    """
-    layers = {
+def _attn_block_specs(cfg: ModelConfig) -> Params:
+    block = {
         "attn_norm": P(None, None),
         "wq": P(None, None, "tp"),
         "wk": P(None, None, "tp"),
         "wv": P(None, None, "tp"),
         "wo": P(None, "tp", None),
         "mlp_norm": P(None, None),
-        "wg": P(None, None, "tp"),
-        "wu": P(None, None, "tp"),
-        "wd": P(None, "tp", None),
     }
     if cfg.attn_bias:
-        layers["bq"] = P(None, "tp")
-        layers["bk"] = P(None, "tp")
-        layers["bv"] = P(None, "tp")
+        block["bq"] = P(None, "tp")
+        block["bk"] = P(None, "tp")
+        block["bv"] = P(None, "tp")
+    return block
+
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpecs matching ``init_params``' tree (axes: ("dp","sp","tp")).
+
+    Column-parallel: wq/wk/wv/wg/wu (output dim over tp). Row-parallel:
+    wo/wd (input dim over tp, XLA all-reduces the partial sums). Expert
+    weights split their intermediate dim over tp (column for eg/eu, row for
+    ed) — every expert runs tensor-parallel, which composes with the
+    scan-over-experts dispatch. Embedding sharded over vocab; lm_head over
+    vocab columns.
+    """
+    layers = _attn_block_specs(cfg)
+    layers.update(
+        {
+            "wg": P(None, None, "tp"),
+            "wu": P(None, None, "tp"),
+            "wd": P(None, "tp", None),
+        }
+    )
     specs: Params = {
         "embed": P("tp", None),
         "layers": layers,
         "final_norm": P(None),
     }
+    Ld, Lm = _layer_split(cfg)
+    if Lm:
+        moe_layers = _attn_block_specs(cfg)
+        moe_layers.update(
+            {
+                "router": P(None, None, None),
+                "eg": P(None, None, None, "tp"),
+                "eu": P(None, None, None, "tp"),
+                "ed": P(None, None, "tp", None),
+            }
+        )
+        if cfg.moe.num_shared_experts:
+            moe_layers["sg"] = P(None, None, "tp")
+            moe_layers["su"] = P(None, None, "tp")
+            moe_layers["sd"] = P(None, "tp", None)
+        specs["moe_layers"] = moe_layers
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
@@ -155,6 +238,122 @@ def _mlp(x: jax.Array, lp: Params) -> jax.Array:
     return (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
 
 
+def _moe_mlp(
+    h: jax.Array, lp: Params, cfg: ModelConfig
+) -> tuple[jax.Array, jax.Array]:
+    """DeepSeek-style MoE MLP: softmax router, top-k (renormalized among the
+    selected), always-on shared experts, plus a scan over routed experts.
+    Returns (output, load-balance aux loss).
+
+    The scan-over-experts dispatch computes every expert on every token and
+    masks by the combine weight — E× the active FLOPs, but no ragged
+    scatter/gather and no [tokens, E, f] intermediate, and each expert's
+    matmuls stay TP-sharded. (A grouped-matmul dispatch that skips inactive
+    experts is the planned Pallas follow-up; correctness and sharding do not
+    change.)
+
+    Aux = Switch-Transformer balance loss E·Σ_e f_e·P_e (f_e = fraction of
+    token-slots routed to expert e, P_e = mean router probability): minimized
+    at uniform routing, it counteracts the router's winner-take-all dynamic
+    during fine-tuning (weighted into the loss by TrainConfig.moe_aux_weight;
+    serving paths discard it)."""
+    m = cfg.moe
+    E, k = m.num_experts, m.num_experts_per_token
+    router_logits = (h.astype(jnp.float32) @ lp["router"])          # [B,S,E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    vals, idx = jax.lax.top_k(probs, k)                             # [B,S,k]
+    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    sel = jnp.sum(jax.nn.one_hot(idx, E, dtype=probs.dtype), axis=-2)  # [B,S,E]
+    f_e = jnp.mean(sel / k, axis=(0, 1))                            # [E]
+    p_e = jnp.mean(probs, axis=(0, 1))                              # [E]
+    aux = E * jnp.sum(f_e * p_e)
+    combine = jnp.sum(
+        jax.nn.one_hot(idx, E, dtype=vals.dtype) * vals[..., None], axis=-2
+    )                                                               # [B,S,E]
+    combine = jnp.moveaxis(combine, -1, 0).astype(h.dtype)          # [E,B,S]
+
+    def expert_step(acc, scanned):
+        eg, eu, ed, c = scanned
+        y = (jax.nn.silu(h @ eg) * (h @ eu)) @ ed
+        return acc + c[..., None] * y, None
+
+    out, _ = jax.lax.scan(
+        expert_step,
+        jnp.zeros_like(h),
+        (lp["eg"], lp["eu"], lp["ed"], combine),
+    )
+    if m.num_shared_experts:
+        out = out + (jax.nn.silu(h @ lp["sg"]) * (h @ lp["su"])) @ lp["sd"]
+    return out, aux
+
+
+# AttnFn: (normed hidden, layer params, k_pages, v_pages) ->
+#         (attn out [B, S, q_size], k_pages, v_pages)
+AttnFn = Callable[[jax.Array, Params, Any, Any], tuple[jax.Array, Any, Any]]
+
+
+def _run_stack(
+    params: Params,
+    cfg: ModelConfig,
+    x: jax.Array,
+    attn_fn: AttnFn,
+    cache: Params | None,
+    remat: bool = False,
+) -> tuple[jax.Array, Params | None, jax.Array]:
+    """Run the dense stack then (if configured) the MoE stack; returns
+    (final hidden states, updated cache or None, summed MoE aux loss)."""
+    Ld, Lm = _layer_split(cfg)
+
+    def make_body(moe: bool):
+        def body(carry, scanned):
+            x, aux = carry
+            if cache is None:
+                lp, pages = scanned, (None, None)
+            else:
+                lp, *pages = scanned
+                pages = tuple(pages)
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            attn, k_pages, v_pages = attn_fn(h, lp, *pages)
+            x = x + attn @ lp["wo"]
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            if moe:
+                y, layer_aux = _moe_mlp(h, lp, cfg)
+                x, aux = x + y, aux + layer_aux
+            else:
+                x = x + _mlp(h, lp)
+            if cache is None:
+                return (x, aux), None
+            return (x, aux), (k_pages, v_pages)
+        return jax.checkpoint(body) if remat else body
+
+    k_parts, v_parts = [], []
+    carry = (x, jnp.zeros((), jnp.float32))
+
+    def run(carry, layer_params, L0, L1, moe):
+        if L1 == L0:
+            return carry
+        sl = (
+            layer_params if cache is None
+            else (layer_params, cache["k"][L0:L1], cache["v"][L0:L1])
+        )
+        carry, out = jax.lax.scan(make_body(moe), carry, sl)
+        if cache is not None:
+            k_parts.append(out[0])
+            v_parts.append(out[1])
+        return carry
+
+    carry = run(carry, params["layers"], 0, Ld, moe=False)
+    if Lm:
+        carry = run(carry, params["moe_layers"], Ld, Ld + Lm, moe=True)
+    x, aux = carry
+    if cache is None:
+        return x, None, aux
+    return x, {
+        "k": k_parts[0] if len(k_parts) == 1 else jnp.concatenate(k_parts),
+        "v": v_parts[0] if len(v_parts) == 1 else jnp.concatenate(v_parts),
+    }, aux
+
+
 # -- forward passes ---------------------------------------------------------
 def prefill(
     params: Params,
@@ -173,9 +372,7 @@ def prefill(
     x = params["embed"][tokens].astype(dtype)
     start = jnp.zeros((B,), jnp.int32)
 
-    def body(x, scanned):
-        lp, k_pages, v_pages = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    def attn_fn(h, lp, k_pages, v_pages):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -183,19 +380,14 @@ def prefill(
             k_pages, v_pages, k, v, page_table, start, valid_len=lengths
         )
         attn = causal_prefill_attention(q, k, v, lengths=lengths)
-        x = x + attn.reshape(B, S, -1) @ lp["wo"]
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h, lp)
-        return x, (k_pages, v_pages)
+        return attn.reshape(B, S, -1), k_pages, v_pages
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.clip(lengths - 1, 0, S - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
     logits = _lm_head(params, cfg, x_last)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, cache
 
 
 def prefill_with_prefix(
@@ -210,17 +402,13 @@ def prefill_with_prefix(
 ) -> tuple[jax.Array, Params]:
     """Prefix-cache admission: forward only the tail, attending over the
     sequence's cached prefix pages + the tail KV written this call. Returns
-    (last-tail-position logits [B, V], updated cache). With start=0 this is
-    semantically ``prefill`` (kept separate so the no-prefix program avoids
-    the page gather)."""
+    (last-tail-position logits [B, V], updated cache)."""
     B, S = tokens.shape
     positions = start[:, None] + jnp.arange(S)[None, :]
     cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)
 
-    def body(x, scanned):
-        lp, k_pages, v_pages = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    def attn_fn(h, lp, k_pages, v_pages):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -230,19 +418,14 @@ def prefill_with_prefix(
         attn = paged_prefix_attention(
             q, k_pages, v_pages, page_table, start, lengths
         )
-        x = x + attn.reshape(B, S, -1) @ lp["wo"]
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h, lp)
-        return x, (k_pages, v_pages)
+        return attn.reshape(B, S, -1), k_pages, v_pages
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.clip(lengths - 1, 0, S - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, cfg, x_last)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, cache
 
 
 def decode_step(
@@ -264,9 +447,7 @@ def decode_step(
     x = params["embed"][tokens[:, None]].astype(dtype)  # [B, 1, D]
     valid = active.astype(jnp.int32)                   # [B] 1 new token if active
 
-    def body(x, scanned):
-        lp, k_pages, v_pages = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    def attn_fn(h, lp, k_pages, v_pages):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -277,17 +458,12 @@ def decode_step(
             q[:, 0], k_pages, v_pages, page_table, lengths + valid,
             impl=attn_impl,
         )
-        x = x + attn.reshape(B, 1, -1) @ lp["wo"]
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h, lp)
-        return x, (k_pages, v_pages)
+        return attn.reshape(B, 1, -1), k_pages, v_pages
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _lm_head(params, cfg, x[:, 0])
-    return logits, {"k": k_new, "v": v_new}
+    return logits, cache
 
 
 def _lm_head(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
@@ -302,29 +478,27 @@ def forward_full(
     tokens: jax.Array,
     dtype: jnp.dtype = jnp.bfloat16,
     remat: bool = False,
+    return_aux: bool = False,
 ) -> jax.Array:
     """All-positions logits [B, S, V] with vanilla causal attention and no
     cache — the ground-truth oracle for prefill/decode equivalence tests and
     the loss path for the training step. ``remat=True`` checkpoints the
-    scanned layer body (recompute activations in backward: HBM for FLOPs)."""
+    scanned layer body (recompute activations in backward: HBM for FLOPs).
+    ``return_aux=True`` also returns the summed MoE load-balance loss
+    (zero for dense models)."""
     B, S = tokens.shape
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)
 
-    def body(x, lp):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    def attn_fn(h, lp, k_pages, v_pages):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         attn = causal_prefill_attention(q, k, v)
-        x = x + attn.reshape(B, S, -1) @ lp["wo"]
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h, lp)
-        return x, None
+        return attn.reshape(B, S, -1), k_pages, v_pages
 
-    if remat:
-        body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, _, aux = _run_stack(params, cfg, x, attn_fn, cache=None, remat=remat)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return _lm_head(params, cfg, x)
+    logits = _lm_head(params, cfg, x)
+    return (logits, aux) if return_aux else logits

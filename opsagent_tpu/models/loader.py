@@ -65,45 +65,110 @@ def _maybe(tensors: dict[str, Any], name: str) -> np.ndarray | None:
     return fh.get_tensor(key)
 
 
-def load_checkpoint(
-    path: str, cfg: ModelConfig, dtype: Any = jnp.bfloat16
+def _load_attn_block(
+    tensors, cfg: ModelConfig, layer_ids: list[int], dtype
 ) -> dict[str, Any]:
-    """Load an HF llama/qwen checkpoint into the stacked param layout."""
-    tensors = _open_shards(path)
-    L = cfg.num_layers
+    """Attention weights + norms for an explicit list of HF layer indices,
+    stacked in that order."""
 
     def linear(name_fmt: str) -> jnp.ndarray:
         # HF stores [out, in]; we use [in, out]. Stack over layers.
-        mats = [
-            _get(tensors, name_fmt.format(i)).T for i in range(L)
-        ]
+        mats = [_get(tensors, name_fmt.format(i)).T for i in layer_ids]
         return jnp.asarray(np.stack(mats), dtype=dtype)
 
     def vector(name_fmt: str) -> jnp.ndarray:
-        vecs = [_get(tensors, name_fmt.format(i)) for i in range(L)]
+        vecs = [_get(tensors, name_fmt.format(i)) for i in layer_ids]
         return jnp.asarray(np.stack(vecs), dtype=dtype)
 
-    layers: dict[str, Any] = {
+    block: dict[str, Any] = {
         "attn_norm": vector("model.layers.{}.input_layernorm.weight"),
         "wq": linear("model.layers.{}.self_attn.q_proj.weight"),
         "wk": linear("model.layers.{}.self_attn.k_proj.weight"),
         "wv": linear("model.layers.{}.self_attn.v_proj.weight"),
         "wo": linear("model.layers.{}.self_attn.o_proj.weight"),
         "mlp_norm": vector("model.layers.{}.post_attention_layernorm.weight"),
-        "wg": linear("model.layers.{}.mlp.gate_proj.weight"),
-        "wu": linear("model.layers.{}.mlp.up_proj.weight"),
-        "wd": linear("model.layers.{}.mlp.down_proj.weight"),
     }
     if cfg.attn_bias:
-        layers["bq"] = vector("model.layers.{}.self_attn.q_proj.bias")
-        layers["bk"] = vector("model.layers.{}.self_attn.k_proj.bias")
-        layers["bv"] = vector("model.layers.{}.self_attn.v_proj.bias")
+        block["bq"] = vector("model.layers.{}.self_attn.q_proj.bias")
+        block["bk"] = vector("model.layers.{}.self_attn.k_proj.bias")
+        block["bv"] = vector("model.layers.{}.self_attn.v_proj.bias")
+    return block
+
+
+def load_checkpoint(
+    path: str, cfg: ModelConfig, dtype: Any = jnp.bfloat16
+) -> dict[str, Any]:
+    """Load an HF llama/qwen/deepseek-moe checkpoint into the stacked param
+    layout. MoE layers use the DeepSeek naming scheme: ``mlp.gate.weight``
+    (router), ``mlp.experts.{e}.{gate,up,down}_proj.weight``, and fused
+    ``mlp.shared_experts.{gate,up,down}_proj.weight``."""
+    tensors = _open_shards(path)
+    L = cfg.num_layers
+    Ld = cfg.moe_layer_start if cfg.moe is not None else L
+    dense_ids, moe_ids = list(range(Ld)), list(range(Ld, L))
+
+    def linear_ids(name_fmt: str, ids: list[int]) -> jnp.ndarray:
+        mats = [_get(tensors, name_fmt.format(i)).T for i in ids]
+        return jnp.asarray(np.stack(mats), dtype=dtype)
+
+    layers = _load_attn_block(tensors, cfg, dense_ids, dtype)
+    layers.update(
+        {
+            "wg": linear_ids("model.layers.{}.mlp.gate_proj.weight", dense_ids),
+            "wu": linear_ids("model.layers.{}.mlp.up_proj.weight", dense_ids),
+            "wd": linear_ids("model.layers.{}.mlp.down_proj.weight", dense_ids),
+        }
+    )
 
     params: dict[str, Any] = {
         "embed": jnp.asarray(_get(tensors, "model.embed_tokens.weight"), dtype=dtype),
         "layers": layers,
         "final_norm": jnp.asarray(_get(tensors, "model.norm.weight"), dtype=dtype),
     }
+
+    if moe_ids:
+        E = cfg.moe.num_experts
+        moe_layers = _load_attn_block(tensors, cfg, moe_ids, dtype)
+
+        def experts(proj: str) -> jnp.ndarray:
+            # [Lm, E, in, out]
+            mats = [
+                np.stack([
+                    _get(
+                        tensors,
+                        f"model.layers.{i}.mlp.experts.{e}.{proj}.weight",
+                    ).T
+                    for e in range(E)
+                ])
+                for i in moe_ids
+            ]
+            return jnp.asarray(np.stack(mats), dtype=dtype)
+
+        moe_layers.update(
+            {
+                "router": jnp.asarray(
+                    np.stack([
+                        _get(tensors, f"model.layers.{i}.mlp.gate.weight").T
+                        for i in moe_ids
+                    ]),
+                    dtype=jnp.float32,
+                ),
+                "eg": experts("gate_proj"),
+                "eu": experts("up_proj"),
+                "ed": experts("down_proj"),
+            }
+        )
+        if cfg.moe.num_shared_experts:
+            moe_layers["sg"] = linear_ids(
+                "model.layers.{}.mlp.shared_experts.gate_proj.weight", moe_ids
+            )
+            moe_layers["su"] = linear_ids(
+                "model.layers.{}.mlp.shared_experts.up_proj.weight", moe_ids
+            )
+            moe_layers["sd"] = linear_ids(
+                "model.layers.{}.mlp.shared_experts.down_proj.weight", moe_ids
+            )
+        params["moe_layers"] = moe_layers
     head = _maybe(tensors, "lm_head.weight")
     if cfg.tie_embeddings or head is None:
         if not cfg.tie_embeddings and head is None:
@@ -123,36 +188,78 @@ def load_checkpoint(
     return params
 
 
-def save_checkpoint(path: str, params: dict[str, Any]) -> None:
-    """Write params back out as a single HF-style safetensors file (testing
-    and fine-tune export)."""
-    from safetensors.numpy import save_file
+_ATTN_NAME_MAP = {
+    "attn_norm": "model.layers.{}.input_layernorm.weight",
+    "wq": "model.layers.{}.self_attn.q_proj.weight",
+    "wk": "model.layers.{}.self_attn.k_proj.weight",
+    "wv": "model.layers.{}.self_attn.v_proj.weight",
+    "wo": "model.layers.{}.self_attn.o_proj.weight",
+    "mlp_norm": "model.layers.{}.post_attention_layernorm.weight",
+    "bq": "model.layers.{}.self_attn.q_proj.bias",
+    "bk": "model.layers.{}.self_attn.k_proj.bias",
+    "bv": "model.layers.{}.self_attn.v_proj.bias",
+}
 
-    flat: dict[str, np.ndarray] = {}
-    L = params["layers"]["wq"].shape[0]
-    name_map = {
-        "attn_norm": "model.layers.{}.input_layernorm.weight",
-        "wq": "model.layers.{}.self_attn.q_proj.weight",
-        "wk": "model.layers.{}.self_attn.k_proj.weight",
-        "wv": "model.layers.{}.self_attn.v_proj.weight",
-        "wo": "model.layers.{}.self_attn.o_proj.weight",
-        "mlp_norm": "model.layers.{}.post_attention_layernorm.weight",
-        "wg": "model.layers.{}.mlp.gate_proj.weight",
-        "wu": "model.layers.{}.mlp.up_proj.weight",
-        "wd": "model.layers.{}.mlp.down_proj.weight",
-        "bq": "model.layers.{}.self_attn.q_proj.bias",
-        "bk": "model.layers.{}.self_attn.k_proj.bias",
-        "bv": "model.layers.{}.self_attn.v_proj.bias",
-    }
+_DENSE_MLP_NAME_MAP = {
+    "wg": "model.layers.{}.mlp.gate_proj.weight",
+    "wu": "model.layers.{}.mlp.up_proj.weight",
+    "wd": "model.layers.{}.mlp.down_proj.weight",
+}
+
+_SHARED_NAME_MAP = {
+    "sg": "model.layers.{}.mlp.shared_experts.gate_proj.weight",
+    "su": "model.layers.{}.mlp.shared_experts.up_proj.weight",
+    "sd": "model.layers.{}.mlp.shared_experts.down_proj.weight",
+}
+
+_EXPERT_NAME_MAP = {
+    "eg": "model.layers.{}.mlp.experts.{}.gate_proj.weight",
+    "eu": "model.layers.{}.mlp.experts.{}.up_proj.weight",
+    "ed": "model.layers.{}.mlp.experts.{}.down_proj.weight",
+}
+
+
+def _dump_block(
+    flat: dict[str, np.ndarray],
+    block: dict[str, Any],
+    name_map: dict[str, str],
+    layer_offset: int,
+) -> None:
     for key, fmt in name_map.items():
-        if key not in params["layers"]:
+        if key not in block:
             continue
-        stacked = np.asarray(params["layers"][key].astype(jnp.float32))
-        for i in range(L):
+        stacked = np.asarray(block[key].astype(jnp.float32))
+        for i in range(stacked.shape[0]):
             mat = stacked[i]
             if mat.ndim == 2:
                 mat = mat.T  # back to HF [out, in]
-            flat[fmt.format(i)] = np.ascontiguousarray(mat)
+            flat[fmt.format(i + layer_offset)] = np.ascontiguousarray(mat)
+
+
+def save_checkpoint(path: str, params: dict[str, Any]) -> None:
+    """Write params back out as a single HF-style safetensors file (testing
+    and fine-tune export). MoE stacks round-trip through the DeepSeek naming
+    scheme ``load_checkpoint`` reads."""
+    from safetensors.numpy import save_file
+
+    flat: dict[str, np.ndarray] = {}
+    Ld = params["layers"]["wq"].shape[0]
+    _dump_block(flat, params["layers"], {**_ATTN_NAME_MAP, **_DENSE_MLP_NAME_MAP}, 0)
+    if "moe_layers" in params:
+        moe = params["moe_layers"]
+        _dump_block(flat, moe, {**_ATTN_NAME_MAP, **_SHARED_NAME_MAP}, Ld)
+        router = np.asarray(moe["router"].astype(jnp.float32))
+        for i in range(router.shape[0]):
+            flat[f"model.layers.{i + Ld}.mlp.gate.weight"] = (
+                np.ascontiguousarray(router[i].T)
+            )
+        for key, fmt in _EXPERT_NAME_MAP.items():
+            stacked = np.asarray(moe[key].astype(jnp.float32))
+            for i in range(stacked.shape[0]):
+                for e in range(stacked.shape[1]):
+                    flat[fmt.format(i + Ld, e)] = np.ascontiguousarray(
+                        stacked[i, e].T
+                    )
     flat["model.embed_tokens.weight"] = np.asarray(
         params["embed"].astype(jnp.float32)
     )

@@ -40,6 +40,10 @@ class TrainConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     remat: bool = True
+    # Weight of the MoE router load-balance loss (Switch-style E·Σ f_e·P_e):
+    # without it top-k routing is winner-take-all and experts die during
+    # fine-tuning. Ignored (aux is 0) for dense models.
+    moe_aux_weight: float = 0.01
 
 
 def cross_entropy_loss(
@@ -106,19 +110,24 @@ def make_train_step(
         # length BEFORE the model makes XLA pad the sp shards unevenly, and
         # the padded attention lanes (scores -1e30, squared in the backward)
         # overflow to inf -> NaN grads. Shift-at-the-loss avoids it.
-        logits = llama.forward_full(
-            params, cfg, tokens, dtype=dtype, remat=tc.remat
+        logits, aux = llama.forward_full(
+            params, cfg, tokens, dtype=dtype, remat=tc.remat, return_aux=True
         )
-        return cross_entropy_loss(
+        ce = cross_entropy_loss(
             logits[:, :-1], tokens[:, 1:], loss_mask[:, 1:]
         )
+        return ce + tc.moe_aux_weight * aux, (ce, aux)
 
     def step(params, opt_state, tokens, loss_mask):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, loss_mask)
+        (_, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, tokens, loss_mask
+        )
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)
-        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+        return params, opt_state, {
+            "loss": ce, "moe_aux": aux, "grad_norm": gnorm,
+        }
 
     jitted = jax.jit(
         step,

@@ -1,0 +1,163 @@
+"""MoE layer stack (DeepSeek-style): routing math, oracle equivalence of the
+serving paths, engine generation, and sharded execution on the 8-device mesh.
+Capability target: BASELINE.json config 3 (DeepSeek function calling)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from opsagent_tpu.models import llama
+from opsagent_tpu.models.config import get_config_preset
+
+
+CFG = get_config_preset("tiny-moe")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def test_param_tree_shapes(params):
+    m = CFG.moe
+    Lm = CFG.num_layers - CFG.moe_layer_start
+    fe = m.expert_intermediate_size
+    assert params["layers"]["wg"].shape[0] == CFG.moe_layer_start
+    assert params["moe_layers"]["eg"].shape == (
+        Lm, m.num_experts, CFG.hidden_size, fe
+    )
+    assert params["moe_layers"]["router"].shape == (
+        Lm, CFG.hidden_size, m.num_experts
+    )
+    assert params["moe_layers"]["sg"].shape == (
+        Lm, CFG.hidden_size, fe * m.num_shared_experts
+    )
+    # Specs tree must mirror the params tree exactly.
+    jax.tree.map(lambda a, b: None, params, llama.param_specs(CFG))
+
+
+def test_router_topk_normalized(params):
+    """Top-k combine weights are nonnegative, sum to 1, with exactly k live."""
+    lp = jax.tree.map(lambda a: a[0], params["moe_layers"])
+    h = jax.random.normal(jax.random.PRNGKey(1), (2, 5, CFG.hidden_size))
+    m = CFG.moe
+    logits = h.astype(jnp.float32) @ lp["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    vals, idx = jax.lax.top_k(probs, m.num_experts_per_token)
+    w = vals / vals.sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-5)
+    assert (np.asarray(vals) > 0).all()
+
+
+def test_prefill_decode_match_forward_full(params):
+    """The serving path (prefill + N decode steps) must reproduce the
+    all-positions oracle through the MoE stack."""
+    rng = np.random.default_rng(0)
+    n = 12
+    toks = rng.integers(1, CFG.vocab_size, n).astype(np.int32)
+
+    # Oracle: all-positions logits.
+    full = llama.forward_full(
+        params, CFG, jnp.asarray(toks[None, :]), dtype=jnp.float32
+    )
+
+    # Serving: prefill 8, then 4 decode steps.
+    P, NP, MaxP = 4, 16, 8
+    cache = llama.make_cache(CFG, NP, P, dtype=jnp.float32)
+    table = np.full((1, MaxP), -1, np.int32)
+    table[0, :4] = [0, 1, 2, 3]
+    buck = np.zeros((1, 16), np.int32)
+    buck[0, :8] = toks[:8]
+    logits, cache = llama.prefill(
+        params, CFG, jnp.asarray(buck), jnp.asarray([8], jnp.int32),
+        cache, jnp.asarray(table), dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(full[0, 7]), rtol=2e-4, atol=2e-4
+    )
+    for i in range(8, n):
+        logits, cache = llama.decode_step(
+            params, CFG, jnp.asarray([toks[i]], jnp.int32),
+            jnp.asarray([i], jnp.int32), cache, jnp.asarray(table),
+            jnp.asarray([True]), dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(full[0, i]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+def test_engine_generates_with_moe():
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    eng = Engine(EngineConfig(
+        model="tiny-moe", dtype=jnp.float32, page_size=8, num_pages=64,
+        max_pages_per_seq=8, max_batch_size=2, prefill_buckets=(16, 32),
+    ))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 500, 10).tolist(), rng.integers(1, 500, 20).tolist()]
+    outs = eng.generate(prompts, SamplingParams(temperature=0.0, max_tokens=5))
+    assert all(1 <= len(o) <= 5 for o in outs)
+    # Greedy determinism through the MoE stack (fresh engine, same prompts).
+    outs2 = eng.generate(prompts, SamplingParams(temperature=0.0, max_tokens=5))
+    assert outs == outs2
+
+
+def test_moe_checkpoint_roundtrip(tmp_path, params):
+    """save_checkpoint must emit the full MoE tree (router, experts, shared)
+    in DeepSeek HF naming, and load_checkpoint must rebuild it exactly."""
+    from opsagent_tpu.models.loader import load_checkpoint, save_checkpoint
+
+    path = str(tmp_path / "moe.safetensors")
+    save_checkpoint(path, params)
+    reloaded = load_checkpoint(path, CFG, dtype=jnp.float32)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-6, atol=1e-6,
+        ),
+        params,
+        reloaded,
+    )
+
+
+def test_moe_aux_loss_reported():
+    from opsagent_tpu.parallel.mesh import make_mesh
+    from opsagent_tpu.training import TrainConfig, init_train_state, make_train_step
+
+    mesh = make_mesh(tp=1, dp=1, sp=1, devices=jax.devices()[:1])
+    tc = TrainConfig(remat=False)
+    params, opt_state = init_train_state(
+        CFG, tc, mesh, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    step = make_train_step(CFG, tc, mesh, dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(1, 500, (2, 16)), jnp.int32
+    )
+    _, _, metrics = step(params, opt_state, tokens, jnp.ones((2, 16)))
+    aux = float(metrics["moe_aux"])
+    # Switch aux is >= 1 (equality at perfectly uniform routing), summed
+    # over the MoE layers.
+    assert aux >= 1.0
+
+
+def test_sharded_moe_training_step():
+    """Full training step over tiny-moe on the virtual 8-device mesh: the
+    expert TP shardings must compile and produce a finite loss."""
+    from opsagent_tpu.parallel.mesh import make_mesh
+    from opsagent_tpu.training import TrainConfig, init_train_state, make_train_step
+
+    mesh = make_mesh(tp=2, dp=2, sp=2)
+    tc = TrainConfig(remat=True)
+    params, opt_state = init_train_state(
+        CFG, tc, mesh, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    step = make_train_step(CFG, tc, mesh, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(1, 500, (4, 32)), jnp.int32)
+    mask = jnp.ones((4, 32), jnp.float32)
+    params, opt_state, metrics = step(params, opt_state, tokens, mask)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss)
