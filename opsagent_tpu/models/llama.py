@@ -479,6 +479,7 @@ def forward_full(
     dtype: jnp.dtype = jnp.bfloat16,
     remat: bool = False,
     return_aux: bool = False,
+    prefill_attn: Callable | None = None,  # e.g. parallel.ring attention
 ) -> jax.Array:
     """All-positions logits [B, S, V] with vanilla causal attention and no
     cache — the ground-truth oracle for prefill/decode equivalence tests and
@@ -490,12 +491,13 @@ def forward_full(
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)
+    attn_op = prefill_attn or causal_prefill_attention
 
     def attn_fn(h, lp, k_pages, v_pages):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        attn = causal_prefill_attention(q, k, v)
+        attn = attn_op(q, k, v)
         return attn.reshape(B, S, -1), k_pages, v_pages
 
     x, _, aux = _run_stack(params, cfg, x, attn_fn, cache=None, remat=remat)

@@ -65,16 +65,36 @@ def _maybe(tensors: dict[str, Any], name: str) -> np.ndarray | None:
     return fh.get_tensor(key)
 
 
+def _stack_linear(tensors, name_fmt: str, ids: list[int], dtype) -> jnp.ndarray:
+    """HF stores [out, in]; we use [in, out]. Stack over the given layers."""
+    mats = [_get(tensors, name_fmt.format(i)).T for i in ids]
+    return jnp.asarray(np.stack(mats), dtype=dtype)
+
+
 def _load_attn_block(
     tensors, cfg: ModelConfig, layer_ids: list[int], dtype
 ) -> dict[str, Any]:
     """Attention weights + norms for an explicit list of HF layer indices,
-    stacked in that order."""
+    stacked in that order. An empty id list (e.g. moe_layer_start=0) yields
+    zero-length stacks matching init_params' shapes."""
+    d, q, kv = cfg.hidden_size, cfg.q_size, cfg.kv_size
+    if not layer_ids:
+        block = {
+            "attn_norm": jnp.zeros((0, d), dtype),
+            "wq": jnp.zeros((0, d, q), dtype),
+            "wk": jnp.zeros((0, d, kv), dtype),
+            "wv": jnp.zeros((0, d, kv), dtype),
+            "wo": jnp.zeros((0, q, d), dtype),
+            "mlp_norm": jnp.zeros((0, d), dtype),
+        }
+        if cfg.attn_bias:
+            block["bq"] = jnp.zeros((0, q), dtype)
+            block["bk"] = jnp.zeros((0, kv), dtype)
+            block["bv"] = jnp.zeros((0, kv), dtype)
+        return block
 
     def linear(name_fmt: str) -> jnp.ndarray:
-        # HF stores [out, in]; we use [in, out]. Stack over layers.
-        mats = [_get(tensors, name_fmt.format(i)).T for i in layer_ids]
-        return jnp.asarray(np.stack(mats), dtype=dtype)
+        return _stack_linear(tensors, name_fmt, layer_ids, dtype)
 
     def vector(name_fmt: str) -> jnp.ndarray:
         vecs = [_get(tensors, name_fmt.format(i)) for i in layer_ids]
@@ -108,17 +128,27 @@ def load_checkpoint(
     dense_ids, moe_ids = list(range(Ld)), list(range(Ld, L))
 
     def linear_ids(name_fmt: str, ids: list[int]) -> jnp.ndarray:
-        mats = [_get(tensors, name_fmt.format(i)).T for i in ids]
-        return jnp.asarray(np.stack(mats), dtype=dtype)
+        return _stack_linear(tensors, name_fmt, ids, dtype)
 
     layers = _load_attn_block(tensors, cfg, dense_ids, dtype)
-    layers.update(
-        {
-            "wg": linear_ids("model.layers.{}.mlp.gate_proj.weight", dense_ids),
-            "wu": linear_ids("model.layers.{}.mlp.up_proj.weight", dense_ids),
-            "wd": linear_ids("model.layers.{}.mlp.down_proj.weight", dense_ids),
-        }
-    )
+    f = cfg.intermediate_size
+    if dense_ids:
+        layers.update(
+            {
+                "wg": linear_ids("model.layers.{}.mlp.gate_proj.weight", dense_ids),
+                "wu": linear_ids("model.layers.{}.mlp.up_proj.weight", dense_ids),
+                "wd": linear_ids("model.layers.{}.mlp.down_proj.weight", dense_ids),
+            }
+        )
+    else:
+        d = cfg.hidden_size
+        layers.update(
+            {
+                "wg": jnp.zeros((0, d, f), dtype),
+                "wu": jnp.zeros((0, d, f), dtype),
+                "wd": jnp.zeros((0, f, d), dtype),
+            }
+        )
 
     params: dict[str, Any] = {
         "embed": jnp.asarray(_get(tensors, "model.embed_tokens.weight"), dtype=dtype),

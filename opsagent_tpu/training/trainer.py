@@ -44,6 +44,11 @@ class TrainConfig:
     # without it top-k routing is winner-take-all and experts die during
     # fine-tuning. Ignored (aux is 0) for dense models.
     moe_aux_weight: float = 0.01
+    # Ring attention over the sp axis (context parallelism): K/V shards
+    # rotate via ppermute instead of XLA's default all-gather of the whole
+    # sequence — peak memory O(S/sp) per device, enabling sequences that
+    # cannot fit gathered. No-op on meshes with sp=1.
+    ring_attention: bool = False
 
 
 def cross_entropy_loss(
@@ -103,6 +108,11 @@ def make_train_step(
     """
     opt = make_optimizer(tc)
     data_sharding = NamedSharding(mesh, P("dp", "sp"))
+    prefill_attn = None
+    if tc.ring_attention and mesh.shape.get("sp", 1) > 1:
+        from ..parallel.ring import make_ring_attention
+
+        prefill_attn = make_ring_attention(mesh)
 
     def loss_fn(params, tokens, loss_mask):
         # Attention runs over the full (evenly sp-shardable) sequence; the
@@ -111,7 +121,8 @@ def make_train_step(
         # the padded attention lanes (scores -1e30, squared in the backward)
         # overflow to inf -> NaN grads. Shift-at-the-loss avoids it.
         logits, aux = llama.forward_full(
-            params, cfg, tokens, dtype=dtype, remat=tc.remat, return_aux=True
+            params, cfg, tokens, dtype=dtype, remat=tc.remat, return_aux=True,
+            prefill_attn=prefill_attn,
         )
         ce = cross_entropy_loss(
             logits[:, :-1], tokens[:, 1:], loss_mask[:, 1:]

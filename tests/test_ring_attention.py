@@ -1,0 +1,81 @@
+"""Ring attention (context parallelism over sp): numerical equivalence with
+the dense causal reference on the virtual 8-device mesh, and the training
+step integration (forward + backward through ppermute)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from opsagent_tpu.ops.attention import causal_prefill_attention
+from opsagent_tpu.parallel.mesh import make_mesh
+from opsagent_tpu.parallel.ring import make_ring_attention
+
+
+@pytest.mark.parametrize("dp,sp,tp", [(1, 4, 2), (2, 4, 1), (1, 8, 1)])
+def test_ring_matches_dense_causal(dp, sp, tp):
+    mesh = make_mesh(tp=tp, dp=dp, sp=sp)
+    rng = np.random.default_rng(0)
+    B, S, H, K, D = 2 * dp, 8 * sp, 4, 2, 16
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+
+    ref = causal_prefill_attention(q, k, v)
+    ring = make_ring_attention(mesh)
+    with mesh:
+        got = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_gradients_flow():
+    """value_and_grad through the ring (ppermute in fori_loop) must compile
+    and match dense-attention gradients."""
+    mesh = make_mesh(tp=1, dp=1, sp=4, devices=jax.devices()[:4])
+    rng = np.random.default_rng(1)
+    B, S, H, K, D = 1, 16, 2, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+    ring = make_ring_attention(mesh)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_prefill_attention(q, k, v) ** 2)
+
+    with mesh:
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_train_step_with_ring_matches_dense():
+    """Same data, same init: one training step with ring attention must give
+    the same loss and gradient norm as the dense path."""
+    from opsagent_tpu.models.config import get_config_preset
+    from opsagent_tpu.training import TrainConfig, init_train_state, make_train_step
+
+    cfg = get_config_preset("tiny-test")
+    mesh = make_mesh(tp=2, dp=1, sp=4)
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(1, 500, (2, 32)), jnp.int32)
+    mask = jnp.ones((2, 32), jnp.float32)
+
+    metrics = {}
+    for ring in (False, True):
+        tc = TrainConfig(remat=True, ring_attention=ring)
+        params, opt_state = init_train_state(
+            cfg, tc, mesh, jax.random.PRNGKey(0), dtype=jnp.float32
+        )
+        step = make_train_step(cfg, tc, mesh, dtype=jnp.float32)
+        _, _, m = step(params, opt_state, tokens, mask)
+        metrics[ring] = (float(m["loss"]), float(m["grad_norm"]))
+    np.testing.assert_allclose(metrics[True][0], metrics[False][0], rtol=1e-5)
+    np.testing.assert_allclose(metrics[True][1], metrics[False][1], rtol=1e-4)
