@@ -315,8 +315,22 @@ class TokenFSM:
         for tid, tb in enumerate(token_bytes):
             if tb:
                 self._bytes[tid, : len(tb)] = np.frombuffer(tb, np.uint8)
+        # Native C++ tables when available: full eager precompute, O(row
+        # copy) per step. Falls back to the lazy numpy path silently.
+        self._native = None
+        try:
+            from ..native import NativeFSMTables, get_lib
+
+            if get_lib() is not None:
+                self._native = NativeFSMTables(
+                    dfa.next, dfa.accept, token_bytes, eos_id
+                )
+        except Exception:  # noqa: BLE001 - fallback is always correct
+            self._native = None
 
     def mask_for_state(self, state: int) -> np.ndarray:
+        if self._native is not None:
+            return self._native.mask_for_state(state)
         cached = self._mask_cache.get(state)
         if cached is not None:
             return cached
@@ -338,6 +352,8 @@ class TokenFSM:
         return mask
 
     def advance(self, state: int, token_id: int) -> int:
+        if self._native is not None:
+            return self._native.advance(state, token_id)
         return self.dfa.run(state, self.token_bytes[token_id])
 
 

@@ -1,0 +1,102 @@
+"""Native C++ FSM matcher: build, parity with the pure-Python TokenFSM, and
+graceful fallback when disabled."""
+
+import os
+
+import numpy as np
+import pytest
+
+from opsagent_tpu.native import NativeFSMTables, get_lib
+from opsagent_tpu.serving.constrained import (
+    TOOLPROMPT_SCHEMA,
+    compile_regex,
+    schema_to_regex,
+)
+from opsagent_tpu.serving.tokenizer import ByteTokenizer
+
+native_available = get_lib() is not None
+
+pytestmark = pytest.mark.skipif(
+    not native_available, reason="g++/native build unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def dfa():
+    return compile_regex(schema_to_regex(TOOLPROMPT_SCHEMA))
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return ByteTokenizer()
+
+
+def _python_fsm(dfa, tok):
+    """A TokenFSM with the native path forcibly disabled."""
+    from opsagent_tpu.serving import constrained as c
+
+    fsm = c.TokenFSM.__new__(c.TokenFSM)
+    c.TokenFSM.__init__(fsm, dfa, [
+        tok.token_bytes(t) for t in range(tok.vocab_size)
+    ], tok.eos_id)
+    fsm._native = None
+    return fsm
+
+
+def test_native_masks_match_python(dfa, tok):
+    tb = [tok.token_bytes(t) for t in range(tok.vocab_size)]
+    native = NativeFSMTables(dfa.next, dfa.accept, tb, tok.eos_id)
+    py = _python_fsm(dfa, tok)
+    assert native.num_states == dfa.num_states
+    for state in range(dfa.num_states):
+        np.testing.assert_array_equal(
+            native.mask_for_state(state),
+            py.mask_for_state(state),
+            err_msg=f"state {state}",
+        )
+
+
+def test_native_advance_matches_python(dfa, tok):
+    tb = [tok.token_bytes(t) for t in range(tok.vocab_size)]
+    native = NativeFSMTables(dfa.next, dfa.accept, tb, tok.eos_id)
+    py = _python_fsm(dfa, tok)
+    rng = np.random.default_rng(0)
+    state = dfa.start
+    walked = 0
+    while walked < 200:
+        mask = py.mask_for_state(state)
+        ids = np.flatnonzero(mask)
+        if not len(ids) or (len(ids) == 1 and ids[0] == tok.eos_id):
+            break
+        choices = [i for i in ids if i != tok.eos_id]
+        nxt_tok = int(rng.choice(choices))
+        assert native.advance(state, nxt_tok) == py.advance(state, nxt_tok)
+        state = py.advance(state, nxt_tok)
+        walked += 1
+    assert walked > 10  # the walk actually exercised transitions
+
+
+def test_tokenfsm_uses_native_when_available(dfa, tok):
+    from opsagent_tpu.serving.constrained import json_constraint
+
+    c = json_constraint(tok, TOOLPROMPT_SCHEMA)
+    assert c.fsm._native is not None
+    mask = c([])
+    assert mask[ord("{")]
+
+
+def test_env_disable_falls_back(dfa, tok):
+    from opsagent_tpu import native
+
+    os.environ["OPSAGENT_NATIVE"] = "0"
+    try:
+        assert native.get_lib() is None
+    finally:
+        os.environ.pop("OPSAGENT_NATIVE", None)
+
+
+def test_dead_state_mask_is_empty(dfa, tok):
+    tb = [tok.token_bytes(t) for t in range(tok.vocab_size)]
+    native = NativeFSMTables(dfa.next, dfa.accept, tb, tok.eos_id)
+    assert not native.mask_for_state(-1).any()
+    assert native.advance(-1, 5) == -1
