@@ -82,16 +82,16 @@ def main() -> None:
         f"(first includes prefill compile)")
 
     # Warm up decode (compilation + cache donation settle).
-    for _ in range(4):
-        eng.step(ids)
+    eng.step_block(ids)
     jax.block_until_ready(eng.cache)
 
-    # Steady-state decode.
+    # Steady-state decode: `steps` tokens per sequence, block dispatches.
+    block = eng.cfg.decode_block
     t0 = time.perf_counter()
     produced = 0
-    for _ in range(steps):
-        out = eng.step(ids)
-        produced += len(out)
+    for _ in range(max(1, steps // block)):
+        out = eng.step_block(ids)
+        produced += sum(len(v) for v in out.values())
     jax.block_until_ready(eng.cache)
     dt = time.perf_counter() - t0
 

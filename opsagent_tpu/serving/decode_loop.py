@@ -1,0 +1,87 @@
+"""Device-resident multi-step decode: N (decode + sample) steps per host
+dispatch, as one XLA program.
+
+Why this exists: the engine's original hot loop pulled the sampled token to
+the host after EVERY decode step. A device->host transfer costs a full
+round trip (~10-70 ms on tunneled/pod setups — far more than the decode
+step's own compute), so per-token pulls cap throughput at ~1/RTT regardless
+of model size. Scanning ``n_steps`` decode+sample iterations inside one
+``jax.jit`` amortizes the dispatch AND the single [B, n_steps] token pull
+over the whole block, leaving the device busy back-to-back.
+
+Per-row early exit happens ON DEVICE: a row goes inactive when it samples
+EOS or exhausts its per-dispatch token budget. Inactive rows stop writing KV
+(their page state stays exactly "prompt + accepted[:-1]") and emit pad
+tokens, which the host-side bookkeeping discards. Stop-string checks remain
+host-side — the host walks each row's block output token by token and
+truncates the page allocation back to what it accepted.
+
+Replaces the per-token HTTPS round trip of the reference agent loop
+(reference pkg/assistants/simple.go:343,515) with its tpu-native dual: the
+round trip is now per-BLOCK, not per-token.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..models import llama
+from ..models.config import ModelConfig
+from .sampler import sample
+
+
+def decode_block(
+    params: Any,
+    cfg: ModelConfig,
+    tokens: jax.Array,      # [B] int32: last sampled (not yet written) token
+    write_at: jax.Array,    # [B] int32: tokens already written to cache
+    active: jax.Array,      # [B] bool
+    budgets: jax.Array,     # [B] int32: max tokens this row may emit now
+    cache: Any,             # paged KV pytree (donated by the jit wrapper)
+    page_table: jax.Array,  # [B, MaxP] — pages for the whole block are
+                            # pre-allocated by the caller
+    key: jax.Array,         # PRNG key (threaded through, returned updated)
+    temps: jax.Array,       # [B] float32
+    top_k: jax.Array,       # [B] int32
+    top_p: jax.Array,       # [B] float32
+    eos_id: jax.Array,      # [] int32
+    pad_id: jax.Array,      # [] int32
+    n_steps: int,
+    greedy: bool = False,
+    dtype: jnp.dtype = jnp.bfloat16,
+    attn_impl: str = "xla",
+) -> tuple[jax.Array, Any, jax.Array]:
+    """Run ``n_steps`` fused decode+sample steps; returns
+    (tokens_out [B, n_steps] int32 — pad past a row's finish —, cache, key).
+
+    ``greedy=True`` (trace-time) replaces the sampler with a bare argmax —
+    the agent-loop default (temperature 0, reference pkg/llms/openai.go:73)
+    — because even a top-k candidate scan over a 128k vocab inside the
+    decode loop costs several times the decode step itself on TPU.
+    """
+
+    def body(carry, step_idx):
+        tok, at, act, cache, key = carry
+        logits, cache = llama.decode_step(
+            params, cfg, tok, at, cache, page_table, act,
+            dtype=dtype, attn_impl=attn_impl,
+        )
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            key, sub = jax.random.split(key)
+            nxt = sample(logits, sub, temps, top_k, top_p, None)
+        nxt = jnp.where(act, nxt, pad_id).astype(jnp.int32)
+        at = at + act.astype(jnp.int32)
+        act = act & (nxt != eos_id) & (step_idx + 1 < budgets)
+        return (nxt, at, act, cache, key), nxt
+
+    (tok, at, act, cache, key), toks = jax.lax.scan(
+        body,
+        (tokens, write_at, active, cache, key),
+        jnp.arange(n_steps),
+    )
+    return toks.T, cache, key

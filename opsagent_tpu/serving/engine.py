@@ -50,6 +50,10 @@ class EngineConfig:
     num_pages: int = 2048
     max_pages_per_seq: int = 320   # 5120 tokens: largest bucket + generation
     max_batch_size: int = 8
+    # Decode steps fused into one device dispatch (1 = step-at-a-time).
+    # Each dispatch costs a host->device round trip plus ONE device->host
+    # token pull, so per-token overhead scales as RTT / decode_block.
+    decode_block: int = 16
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
     max_new_tokens_default: int = 1024
     seed: int = 0
@@ -137,9 +141,32 @@ class Engine:
                 params, mc, tokens, start, lengths, cache, table, dtype=dt
             )
 
-        def _decode(params, tokens, lengths, cache, table, active):
-            return llama.decode_step(
+        def _decode_sample(
+            params, tokens, lengths, cache, table, active,
+            key, temps, top_k, top_p, mask,
+        ):
+            """One fused decode+sample dispatch (one round trip, not two)."""
+            logits, cache = llama.decode_step(
                 params, mc, tokens, lengths, cache, table, active, dtype=dt,
+                attn_impl=self.attn_impl,
+            )
+            tok = sample(logits, key, temps, top_k, top_p, mask)
+            return tok.astype(jnp.int32), cache
+
+        def _decode_block(
+            params, tokens, write_at, active, budgets, cache, table,
+            key, temps, top_k, top_p, greedy,
+        ):
+            from .decode_loop import decode_block
+
+            return decode_block(
+                params, mc, tokens, write_at, active, budgets, cache, table,
+                key, temps, top_k, top_p,
+                jnp.int32(self.tokenizer.eos_id),
+                jnp.int32(self.tokenizer.pad_id),
+                n_steps=self.cfg.decode_block,
+                greedy=greedy,
+                dtype=dt,
                 attn_impl=self.attn_impl,
             )
 
@@ -147,7 +174,12 @@ class Engine:
         self._prefill_prefix_jit = jax.jit(
             _prefill_prefix, donate_argnames=("cache",)
         )
-        self._decode_jit = jax.jit(_decode, donate_argnames=("cache",))
+        self._decode_sample_jit = jax.jit(
+            _decode_sample, donate_argnames=("cache",)
+        )
+        self._decode_block_jit = jax.jit(
+            _decode_block, donate_argnames=("cache",), static_argnames=("greedy",)
+        )
         self._sample_jit = jax.jit(sample)
 
     # -- bucketing ---------------------------------------------------------
@@ -250,8 +282,10 @@ class Engine:
                 done += chunk
         return logits
 
-    def _sample_one(self, logits: jax.Array, seqs: list[Sequence]) -> np.ndarray:
-        B = logits.shape[0]
+    def _sampling_arrays(
+        self, seqs: list[Sequence | None], B: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray | None]:
+        """Per-slot (temps, top_k, top_p, allowed-mask-or-None) arrays."""
         temps = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
@@ -272,6 +306,11 @@ class Engine:
                 n = min(len(m), mask.shape[1])
                 mask[i, :n] = m[:n]
                 mask[i, n:] = False
+        return temps, top_k, top_p, mask
+
+    def _sample_one(self, logits: jax.Array, seqs: list[Sequence]) -> np.ndarray:
+        B = logits.shape[0]
+        temps, top_k, top_p, mask = self._sampling_arrays(seqs, B)
         self._sample_key, sub = jax.random.split(self._sample_key)
         tok = self._sample_jit(
             logits,
@@ -348,22 +387,165 @@ class Engine:
             tokens = np.zeros((B,), np.int32)
             for i, s in enumerate(running):
                 tokens[i] = s.tokens[-1] if s.tokens else self.tokenizer.bos_id
+            slots = running + [None] * (B - len(running))
+            temps, top_k, top_p, mask = self._sampling_arrays(slots, B)
+            self._sample_key, sub = jax.random.split(self._sample_key)
             with self.mesh:
-                logits, self.cache = self._decode_jit(
+                sampled, self.cache = self._decode_sample_jit(
                     self.params,
                     jnp.asarray(tokens),
                     jnp.asarray(write_at),
                     self.cache,
                     jnp.asarray(table),
                     jnp.asarray(active),
+                    sub,
+                    jnp.asarray(temps),
+                    jnp.asarray(top_k),
+                    jnp.asarray(top_p),
+                    None if mask is None else jnp.asarray(mask),
                 )
-            sampled = self._sample_one(logits, running + [None] * (B - len(running)))
+            sampled = np.asarray(sampled)
             out: dict[int, int] = {}
             for i, s in enumerate(running):
                 tok = int(sampled[i])
                 self._accept_token(s, tok)
                 out[s.seq_id] = tok
             get_perf_stats().record_metric("engine.decode_tokens", len(running), "tok")
+            return out
+
+    def step_block(self, seq_ids: list[int] | None = None) -> dict[int, list[int]]:
+        """Advance running sequences by up to ``cfg.decode_block`` tokens in
+        ONE device dispatch (one token pull per block instead of per step).
+        Rows with a constrained-decoding mask advance one fused step per
+        call instead (masks are host-computed per token); unconstrained
+        rows in the same batch still block-decode. Returns
+        {seq_id: accepted tokens} for sequences that advanced."""
+        with self.lock:
+            running = [
+                s for s in self.sequences.values() if not s.done
+            ] if seq_ids is None else [
+                self.sequences[i] for i in seq_ids if not self.sequences[i].done
+            ]
+            running = running[: self.cfg.max_batch_size]
+            if not running:
+                return {}
+            block = self.cfg.decode_block
+            masked = [s for s in running if s.mask_fn is not None]
+            plain = [s for s in running if s.mask_fn is None]
+            if block <= 1 or (masked and not plain):
+                return {
+                    sid: [tok]
+                    for sid, tok in self.step(
+                        [s.seq_id for s in running]
+                    ).items()
+                }
+            out_masked: dict[int, list[int]] = {}
+            if masked:
+                # Mixed batch: constrained rows need a host-computed logits
+                # mask per token, so they advance one fused step per call
+                # while the unconstrained rows still block-decode. (Their
+                # inter-token latency grows by the block's device time —
+                # the device-side FSM is the planned fix.)
+                out_masked = {
+                    sid: [tok]
+                    for sid, tok in self.step(
+                        [s.seq_id for s in masked]
+                    ).items()
+                }
+                running = [s for s in plain if not s.done]
+                if not running:
+                    return out_masked
+            B = self.cfg.max_batch_size
+            # Pre-book pages for the whole block; rows that cannot grow at
+            # all right now are truncated (consistent with step()).
+            grown: list[Sequence] = []
+            budgets: list[int] = []
+            base_len: list[int] = []
+            for s in running:
+                want = min(block, s.params.max_tokens - len(s.tokens))
+                want = max(want, 1)
+                before = self.alloc.length(s.seq_id)
+                got = self.alloc.extend_upto(s.seq_id, want)
+                if got == 0:
+                    s.done = True
+                    s.finish_reason = "length"
+                    log.warning(
+                        "seq %d truncated: KV page budget exhausted", s.seq_id
+                    )
+                    continue
+                grown.append(s)
+                budgets.append(got)
+                base_len.append(before)
+            if not grown:
+                return out_masked
+            ids: list[int | None] = [s.seq_id for s in grown]
+            ids += [None] * (B - len(ids))
+            table, _, active = self.alloc.batch_views(ids, B)
+            write_at = np.zeros((B,), np.int32)
+            budget_arr = np.zeros((B,), np.int32)
+            tokens = np.zeros((B,), np.int32)
+            for i, s in enumerate(grown):
+                write_at[i] = base_len[i]
+                budget_arr[i] = budgets[i]
+                tokens[i] = s.tokens[-1] if s.tokens else self.tokenizer.bos_id
+            slots = grown + [None] * (B - len(grown))
+            temps, top_k, top_p, _ = self._sampling_arrays(slots, B)
+            greedy = bool(np.all(temps <= 0.0))
+            perf = get_perf_stats()
+            t_disp = time.perf_counter()
+            with self.mesh:
+                toks, self.cache, self._sample_key = self._decode_block_jit(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray(write_at),
+                    jnp.asarray(active),
+                    jnp.asarray(budget_arr),
+                    self.cache,
+                    jnp.asarray(table),
+                    self._sample_key,
+                    jnp.asarray(temps),
+                    jnp.asarray(top_k),
+                    jnp.asarray(top_p),
+                    greedy=greedy,
+                )
+            t_pull = time.perf_counter()
+            toks = np.asarray(toks)  # the ONE device->host pull per block
+            t_done = time.perf_counter()
+            perf.record_metric(
+                "engine.block_dispatch", (t_pull - t_disp) * 1e3, "ms"
+            )
+            perf.record_metric("engine.block_pull", (t_done - t_pull) * 1e3, "ms")
+            out: dict[int, list[int]] = dict(out_masked)
+            produced = 0
+            first_exc: BaseException | None = None
+            for i, s in enumerate(grown):
+                n0 = len(s.tokens)
+                try:
+                    for j in range(budgets[i]):
+                        self._accept_token(s, int(toks[i, j]))
+                        if s.done:
+                            break
+                except Exception as e:  # noqa: BLE001 - raising stream cb
+                    # A raising stream callback must not skip the page
+                    # rollback (that would poison the prefix cache with
+                    # pages whose KV content outruns the accepted tokens).
+                    if first_exc is None:
+                        first_exc = e
+                    s.done = True
+                    s.finish_reason = s.finish_reason or "error"
+                finally:
+                    accepted = s.tokens[n0:]
+                    # Roll the pre-booked pages back to what was accepted:
+                    # the cache holds [prompt + generated[:-1]] (the last
+                    # sampled token is never written) = base_len + accepted.
+                    self.alloc.truncate(
+                        s.seq_id, base_len[i] + len(accepted)
+                    )
+                    out[s.seq_id] = accepted
+                    produced += len(accepted)
+            get_perf_stats().record_metric("engine.decode_tokens", produced, "tok")
+            if first_exc is not None:
+                raise first_exc
             return out
 
     def finish(self, seq_id: int) -> list[int]:
@@ -386,6 +568,6 @@ class Engine:
         ids = [self.add_request(p, sampling) for p in prompts]
         pending = {i for i in ids if not self.sequences[i].done}
         while pending:
-            self.step(sorted(pending))
+            self.step_block(sorted(pending))
             pending = {i for i in pending if not self.sequences[i].done}
         return [self.finish(i) for i in ids]

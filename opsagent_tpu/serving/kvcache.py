@@ -251,6 +251,43 @@ class PageAllocator:
             seq.pages.append(self._take_free_page())
         seq.length = target
 
+    def extend_upto(self, seq_id: int, want: int) -> int:
+        """Best-effort ``extend``: grow by as many of ``want`` tokens as the
+        per-seq cap and page pool allow; returns the number granted (0 when
+        the sequence cannot grow at all). Used by block decode to pre-book
+        pages for a whole dispatch, then ``truncate`` back what the device
+        did not use."""
+        seq = self._seqs[seq_id]
+        got = min(want, len(seq.pages) * self.page_size - seq.length)
+        seq.length += got
+        while got < want:
+            if len(seq.pages) >= self.max_pages_per_seq:
+                break
+            try:
+                seq.pages.append(self._take_free_page())
+            except OutOfPages:
+                break
+            take = min(want - got, self.page_size)
+            seq.length += take
+            got += take
+        return got
+
+    def truncate(self, seq_id: int, new_length: int) -> None:
+        """Shrink a sequence's accounted length (block-decode rollback of
+        pre-booked-but-unused tokens), releasing whole pages that fall past
+        the new length. Never touches shared (prefix-trie) pages: truncation
+        targets are >= the prompt length, whose pages cover the shared
+        chain."""
+        seq = self._seqs[seq_id]
+        if new_length > seq.length:
+            raise ValueError(
+                f"truncate to {new_length} > current length {seq.length}"
+            )
+        seq.length = new_length
+        keep = max(self.pages_needed(max(1, new_length)), seq.num_shared)
+        while len(seq.pages) > keep:
+            self._free.append(seq.pages.pop())
+
     def free(self, seq_id: int, tokens: list[int] | None = None) -> None:
         """Release a sequence. With ``tokens`` (its full token history) and
         prefix caching on, full pages are donated to the trie instead of

@@ -25,6 +25,18 @@ class SamplingParams:
     stop: tuple[str, ...] = ()
 
 
+# Candidate-set size for top-k / top-p sampling. Full-vocab SORTS are the
+# dominant cost of a fused decode+sample step on TPU (a [B, 128k] sort
+# dwarfs the decode matmuls at small batch), so truncation-based sampling
+# works on the top-MAX_CANDIDATES logits from one cheap ``lax.top_k``.
+# Plain temperature sampling does NOT go through the candidate set — it is
+# computed exactly over the full vocab with the Gumbel-argmax trick (argmax
+# of logits/t + Gumbel noise ~ categorical(softmax(logits/t))), which needs
+# no sort at all. Only requests that themselves ask for truncation
+# (top_k > 0, clamped to 64, or top_p < 1) use the candidate list.
+MAX_CANDIDATES = 64
+
+
 def sample(
     logits: jax.Array,             # [B, V] float32
     key: jax.Array,
@@ -33,28 +45,39 @@ def sample(
     top_p: jax.Array,              # [B] float32 (1.0 = off)
     allowed_mask: jax.Array | None = None,  # [B, V] bool; False = forbidden
 ) -> jax.Array:
-    """Sample one token per row. Rows with temperature<=0 take the argmax."""
+    """Sample one token per row, sort-free. Per row:
+
+    - temperature <= 0: argmax (the agent-loop default).
+    - temperature > 0, no top-k/top-p: EXACT full-vocab categorical via
+      Gumbel-argmax.
+    - top_k > 0 and/or top_p < 1: truncated sampling over the descending
+      top-``MAX_CANDIDATES`` candidate list (top_k clamped to it; top-p
+      mass computed within it), mapped back to vocab ids."""
     B, V = logits.shape
     if allowed_mask is not None:
         logits = jnp.where(allowed_mask, logits, NEG_INF)
 
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+
+    # -- exact paths: greedy and Gumbel-argmax temperature sampling.
+    gumbel = jax.random.gumbel(key, (B, V), dtype=logits.dtype)
+    noisy = jnp.argmax(logits / t + gumbel, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
 
-    # -- top-k: mask everything below the k-th largest logit.
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]          # [B, V]
-    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, V) - 1, 0, V - 1)
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)  # [B, 1]
-    logits_k = jnp.where(logits >= kth, logits, NEG_INF)
+    # -- truncated path over the candidate list.
+    C = min(MAX_CANDIDATES, V)
+    vals, idx = jax.lax.top_k(logits, C)           # [B, C] descending
+    kk = jnp.where(top_k > 0, jnp.minimum(top_k, C), C)      # [B]
+    pos = jnp.arange(C)[None, :]
+    scaled = jnp.where(pos < kk[:, None], vals, NEG_INF) / t
+    # top-p: keep the smallest prefix reaching top_p mass (always >= 1).
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cumsum = jnp.cumsum(probs, axis=-1)
+    keep = cumsum - probs < top_p[:, None]
+    scaled_p = jnp.where(keep, scaled, NEG_INF)
+    choice = jax.random.categorical(key, scaled_p, axis=-1)  # [B] in [0, C)
+    truncated = jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
 
-    # -- top-p over the surviving set.
-    t = jnp.maximum(temperature, 1e-6)[:, None]
-    probs_sorted = jax.nn.softmax(jnp.sort(logits_k / t, axis=-1)[:, ::-1], axis=-1)
-    cumsum = jnp.cumsum(probs_sorted, axis=-1)
-    # Number of tokens needed to reach top_p mass (always keep >= 1).
-    keep_sorted = cumsum - probs_sorted < top_p[:, None]
-    cutoff_val = jnp.sort(logits_k, axis=-1)[:, ::-1]
-    cutoff = jnp.max(jnp.where(keep_sorted, -cutoff_val, NEG_INF), axis=-1)
-    logits_p = jnp.where(logits_k >= -cutoff[:, None], logits_k, NEG_INF)
-
-    sampled = jax.random.categorical(key, logits_p / t, axis=-1)
+    wants_truncation = (top_k > 0) | (top_p < 1.0)
+    sampled = jnp.where(wants_truncation, truncated, noisy)
     return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -179,7 +179,7 @@ class Scheduler:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
-                self.engine.step(sorted(self._running))
+                self.engine.step_block(sorted(self._running))
                 self._reap()
             except Exception as e:  # noqa: BLE001 - the loop must survive
                 log.exception("scheduler step failed; failing in-flight requests")

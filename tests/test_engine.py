@@ -123,3 +123,132 @@ def test_ttft_recorded(engine):
     seq_ttft = engine.sequences[sid].ttft_s
     engine.finish(sid)
     assert seq_ttft > 0
+
+
+# -- block decode (decode_loop.decode_block via Engine.step_block) ----------
+def test_step_block_matches_single_steps(engine):
+    """The multi-step device loop must produce exactly the single-step
+    greedy tokens (same programs, one dispatch)."""
+    prompt = [257, 11, 22, 33, 44]
+    sid1 = engine.add_request(prompt, SamplingParams(max_tokens=10))
+    while not engine.sequences[sid1].done:
+        engine.step([sid1])
+    want = engine.finish(sid1)
+
+    sid2 = engine.add_request(prompt, SamplingParams(max_tokens=10))
+    while not engine.sequences[sid2].done:
+        engine.step_block([sid2])
+    got = engine.finish(sid2)
+    assert got == want
+
+
+def test_step_block_respects_max_tokens(engine):
+    # max_tokens smaller than the block: the device budget must stop the row.
+    prompt = [257, 3, 1, 4, 1, 5]
+    sid = engine.add_request(prompt, SamplingParams(max_tokens=3))
+    while not engine.sequences[sid].done:
+        engine.step_block([sid])
+    got = engine.finish(sid)
+    assert len(got) == 3
+
+
+def test_step_block_stop_string_rolls_back(engine):
+    """A stop string hit mid-block truncates the accepted tokens and rolls
+    the page accounting back; no pages may leak."""
+    free_before = engine.alloc.free_pages
+    prompt = [257, 11, 22, 33, 44]
+    ref = ref_greedy(engine, prompt, 10)
+    stop_txt = engine.tokenizer.decode([ref[1]])
+    sid = engine.add_request(
+        prompt, SamplingParams(max_tokens=10, stop=(stop_txt,))
+    )
+    while not engine.sequences[sid].done:
+        engine.step_block([sid])
+    seq = engine.sequences[sid]
+    assert seq.finish_reason == "stop"
+    got = engine.finish(sid)
+    assert len(got) == 2  # token matching the stop string ends generation
+    assert engine.alloc.free_pages == free_before
+
+
+def test_step_block_batch_with_mixed_finishes(engine):
+    p1 = [257, 10, 20, 30]
+    p2 = [257, 99, 98, 97, 96, 95, 94]
+    want1 = engine.generate([p1], SamplingParams(max_tokens=2))[0]
+    want2 = engine.generate([p2], SamplingParams(max_tokens=9))[0]
+    s1 = engine.add_request(p1, SamplingParams(max_tokens=2))
+    s2 = engine.add_request(p2, SamplingParams(max_tokens=9))
+    while not (engine.sequences[s1].done and engine.sequences[s2].done):
+        engine.step_block([s1, s2])
+    assert engine.finish(s1) == want1
+    assert engine.finish(s2) == want2
+
+
+def test_extend_upto_and_truncate_invariants():
+    from opsagent_tpu.serving.kvcache import PageAllocator
+
+    a = PageAllocator(num_pages=8, page_size=4, max_pages_per_seq=4)
+    sid = a.allocate(6)           # 2 pages
+    assert a.free_pages == 6
+    got = a.extend_upto(sid, 16)  # wants 4 more pages, cap allows 2 more
+    assert got == 10              # 2 slack in page 2 + 2 fresh pages
+    assert a.length(sid) == 16
+    assert a.free_pages == 4
+    a.truncate(sid, 7)
+    assert a.length(sid) == 7
+    assert a.free_pages == 6      # back to 2 pages held
+    a.free(sid)
+    assert a.free_pages == 8
+
+
+def test_step_block_mixed_masked_and_plain(engine):
+    """A constrained row must not stop unconstrained rows from
+    block-decoding, and both must advance correctly together."""
+    prompt_m = [257, 42, 43, 44]
+    prompt_p = [257, 11, 22, 33, 44]
+    want_p = engine.generate([prompt_p], SamplingParams(max_tokens=8))[0]
+    free = ref_greedy(engine, prompt_m, 1)[0]
+
+    def mask_fn(generated):
+        m = np.ones((engine.model_cfg.vocab_size,), bool)
+        m[free] = False
+        return m
+
+    sm = engine.add_request(
+        prompt_m, SamplingParams(max_tokens=4), mask_fn=mask_fn
+    )
+    sp = engine.add_request(prompt_p, SamplingParams(max_tokens=8))
+    while not (engine.sequences[sm].done and engine.sequences[sp].done):
+        out = engine.step_block([sm, sp])
+        if sp in out and not engine.sequences[sp].done:
+            assert len(out[sp]) >= 1
+    got_m = engine.finish(sm)
+    got_p = engine.finish(sp)
+    assert got_p == want_p
+    assert got_m[0] != free
+
+
+def test_step_block_raising_stream_rolls_back_pages(engine):
+    """A stream callback raising mid-block must still roll page accounting
+    back to the accepted tokens (prefix-cache poisoning guard)."""
+    free_before = engine.alloc.free_pages
+
+    calls = []
+
+    def boom(tok):
+        calls.append(tok)
+        if len(calls) == 3:
+            raise RuntimeError("client went away")
+
+    sid = engine.add_request(
+        [257, 5, 6, 7], SamplingParams(max_tokens=12), stream=boom
+    )
+    with pytest.raises(RuntimeError, match="client went away"):
+        while not engine.sequences[sid].done:
+            engine.step_block([sid])
+    seq = engine.sequences[sid]
+    assert seq.done and seq.finish_reason == "error"
+    # allocator length must equal the accepted token count invariant
+    assert engine.alloc.length(sid) == seq.prompt_len + len(seq.tokens) - 1
+    engine.finish(sid)
+    assert engine.alloc.free_pages == free_before

@@ -355,6 +355,11 @@ def test_tpu_scheme_lazy_registration_fresh_process():
     code = (
         "import os\n"
         "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        # Env alone is not enough: a TPU-plugin sitecustomize may have
+        # frozen the platform at interpreter boot (same dance as conftest),
+        # and with an unreachable TPU backend init would hang, not fail.
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
         "from opsagent_tpu.llm.client import ChatClient\n"
         "import sys\n"
         "assert not any('serving' in m for m in sys.modules), 'not lazy'\n"
