@@ -13,6 +13,7 @@ subcommand starts the in-tree TPU serving engine.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from .. import VERSION
@@ -113,7 +114,13 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.command == "server":
-        jwt_key = args.jwt_key or cfg.get("jwt", {}).get("key", "")
+        # Precedence: flag > env (how k8s Secrets are injected,
+        # deploy/kubernetes/deployment-prod.yaml) > config file.
+        jwt_key = (
+            args.jwt_key
+            or os.environ.get("OPSAGENT_JWT_KEY", "")
+            or cfg.get("jwt", {}).get("key", "")
+        )
         set_global("jwtKey", jwt_key)
         set_global("showThought", args.show_thought)
         from ..server.app import run_server

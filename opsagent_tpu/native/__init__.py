@@ -56,6 +56,14 @@ def _build() -> bool:
     return True
 
 
+def build_native() -> None:
+    """Ahead-of-time build entry point (Docker image build / CI): compile
+    ``_native.so`` now and fail loudly, instead of the lazy build-on-first-
+    use with graceful fallback that ``get_lib`` does at runtime."""
+    if not _build():
+        raise RuntimeError("native build failed (see log for compiler output)")
+
+
 def get_lib():
     """The loaded native library, or None when unavailable/disabled."""
     global _lib, _build_failed

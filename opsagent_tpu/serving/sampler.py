@@ -58,9 +58,12 @@ def sample(
         logits = jnp.where(allowed_mask, logits, NEG_INF)
 
     t = jnp.maximum(temperature, 1e-6)[:, None]
+    # Independent keys: the full-vocab Gumbel draw and categorical's
+    # internal draw must not share Threefry counter space.
+    k_noisy, k_trunc = jax.random.split(key)
 
     # -- exact paths: greedy and Gumbel-argmax temperature sampling.
-    gumbel = jax.random.gumbel(key, (B, V), dtype=logits.dtype)
+    gumbel = jax.random.gumbel(k_noisy, (B, V), dtype=logits.dtype)
     noisy = jnp.argmax(logits / t + gumbel, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
 
@@ -75,7 +78,7 @@ def sample(
     cumsum = jnp.cumsum(probs, axis=-1)
     keep = cumsum - probs < top_p[:, None]
     scaled_p = jnp.where(keep, scaled, NEG_INF)
-    choice = jax.random.categorical(key, scaled_p, axis=-1)  # [B] in [0, C)
+    choice = jax.random.categorical(k_trunc, scaled_p, axis=-1)  # [B] in [0, C)
     truncated = jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
 
     wants_truncation = (top_k > 0) | (top_p < 1.0)
