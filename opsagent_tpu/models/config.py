@@ -19,6 +19,14 @@ class MoEConfig:
     num_experts_per_token: int = 2
     num_shared_experts: int = 0
     expert_intermediate_size: int = 0  # 0 = use model intermediate_size
+    # Router combine-weight semantics, matching the HF config fields of the
+    # same names. DeepSeek-MoE-16B / V2-Lite / Qwen2-MoE checkpoints ship
+    # norm_topk_prob=false (combine with raw softmax probabilities);
+    # renormalizing for them scales expert outputs by 1/sum(top-k probs)
+    # (~1.5-3x at k=6 of 64) and corrupts generation. DeepSeek-V3 ships
+    # norm_topk_prob=true with routed_scaling_factor=2.5.
+    norm_topk_prob: bool = False
+    routed_scaling_factor: float = 1.0
 
 
 @dataclass(frozen=True)

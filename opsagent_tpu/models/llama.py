@@ -241,9 +241,10 @@ def _mlp(x: jax.Array, lp: Params) -> jax.Array:
 def _moe_mlp(
     h: jax.Array, lp: Params, cfg: ModelConfig
 ) -> tuple[jax.Array, jax.Array]:
-    """DeepSeek-style MoE MLP: softmax router, top-k (renormalized among the
-    selected), always-on shared experts, plus a scan over routed experts.
-    Returns (output, load-balance aux loss).
+    """DeepSeek-style MoE MLP: softmax router, top-k combine weights
+    (renormalized/scaled per the checkpoint's norm_topk_prob /
+    routed_scaling_factor), always-on shared experts, plus a scan over
+    routed experts. Returns (output, load-balance aux loss).
 
     The scan-over-experts dispatch computes every expert on every token and
     masks by the combine weight — E× the active FLOPs, but no ragged
@@ -262,7 +263,13 @@ def _moe_mlp(
     router_logits = (h.astype(jnp.float32) @ lp["router"])          # [B,S,E]
     probs = jax.nn.softmax(router_logits, axis=-1)
     vals, idx = jax.lax.top_k(probs, k)                             # [B,S,k]
-    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    # Combine-weight semantics follow the checkpoint's HF config: DeepSeek-
+    # MoE-16B/V2-Lite use raw top-k softmax probs (norm_topk_prob=false);
+    # V3 renormalizes among the selected and scales by 2.5.
+    if m.norm_topk_prob:
+        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    if m.routed_scaling_factor != 1.0:
+        vals = vals * m.routed_scaling_factor
     sel = jnp.sum(jax.nn.one_hot(idx, E, dtype=probs.dtype), axis=-2)  # [B,S,E]
     f_e = jnp.mean(sel / k, axis=(0, 1))                            # [E]
     p_e = jnp.mean(probs, axis=(0, 1))                              # [E]
@@ -287,9 +294,13 @@ def _moe_mlp(
     return out, aux
 
 
-# AttnFn: (normed hidden, layer params, k_pages, v_pages) ->
-#         (attn out [B, S, q_size], k_pages, v_pages)
-AttnFn = Callable[[jax.Array, Params, Any, Any], tuple[jax.Array, Any, Any]]
+# AttnFn: (normed hidden, layer params, whole k cache, whole v cache,
+#          layer index) -> (attn out [B, S, q_size], k cache, v cache).
+# The cache arrays keep their full [L, N, P, K, D] shape: attention ops
+# address the layer's pages via the flat offset layer * N.
+AttnFn = Callable[
+    [jax.Array, Params, Any, Any, jax.Array], tuple[jax.Array, Any, Any]
+]
 
 
 def _run_stack(
@@ -301,19 +312,20 @@ def _run_stack(
     remat: bool = False,
 ) -> tuple[jax.Array, Params | None, jax.Array]:
     """Run the dense stack then (if configured) the MoE stack; returns
-    (final hidden states, updated cache or None, summed MoE aux loss)."""
+    (final hidden states, updated cache or None, summed MoE aux loss).
+
+    The KV cache travels through the layer scan as part of the CARRY (one
+    whole-cache array, layer-indexed by the scanned step counter), not as
+    per-layer slices with stacked outputs: stacked scan outputs semantically
+    copy the full cache every call (~GBs per decode step at serving
+    shapes), while scatters into a loop carry update it in place."""
     Ld, Lm = _layer_split(cfg)
 
     def make_body(moe: bool):
-        def body(carry, scanned):
-            x, aux = carry
-            if cache is None:
-                lp, pages = scanned, (None, None)
-            else:
-                lp, *pages = scanned
-                pages = tuple(pages)
+        def body(carry, lp):
+            x, aux, kc, vc, li = carry
             h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            attn, k_pages, v_pages = attn_fn(h, lp, *pages)
+            attn, kc, vc = attn_fn(h, lp, kc, vc, li)
             x = x + attn @ lp["wo"]
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
             if moe:
@@ -321,37 +333,22 @@ def _run_stack(
                 x, aux = x + y, aux + layer_aux
             else:
                 x = x + _mlp(h, lp)
-            if cache is None:
-                return (x, aux), None
-            return (x, aux), (k_pages, v_pages)
+            return (x, aux, kc, vc, li + 1), None
         return jax.checkpoint(body) if remat else body
 
-    k_parts, v_parts = [], []
-    carry = (x, jnp.zeros((), jnp.float32))
-
-    def run(carry, layer_params, L0, L1, moe):
-        if L1 == L0:
-            return carry
-        sl = (
-            layer_params if cache is None
-            else (layer_params, cache["k"][L0:L1], cache["v"][L0:L1])
-        )
-        carry, out = jax.lax.scan(make_body(moe), carry, sl)
-        if cache is not None:
-            k_parts.append(out[0])
-            v_parts.append(out[1])
-        return carry
-
-    carry = run(carry, params["layers"], 0, Ld, moe=False)
+    if cache is None:
+        kc = vc = jnp.zeros((0,), x.dtype)  # pytree placeholder
+    else:
+        kc, vc = cache["k"], cache["v"]
+    carry = (x, jnp.zeros((), jnp.float32), kc, vc, jnp.int32(0))
+    if Ld:
+        carry, _ = jax.lax.scan(make_body(False), carry, params["layers"])
     if Lm:
-        carry = run(carry, params["moe_layers"], Ld, Ld + Lm, moe=True)
-    x, aux = carry
+        carry, _ = jax.lax.scan(make_body(True), carry, params["moe_layers"])
+    x, aux, kc, vc, _ = carry
     if cache is None:
         return x, None, aux
-    return x, {
-        "k": k_parts[0] if len(k_parts) == 1 else jnp.concatenate(k_parts),
-        "v": v_parts[0] if len(v_parts) == 1 else jnp.concatenate(v_parts),
-    }, aux
+    return x, {"k": kc, "v": vc}, aux
 
 
 # -- forward passes ---------------------------------------------------------
@@ -372,15 +369,15 @@ def prefill(
     x = params["embed"][tokens].astype(dtype)
     start = jnp.zeros((B,), jnp.int32)
 
-    def attn_fn(h, lp, k_pages, v_pages):
+    def attn_fn(h, lp, kc, vc, li):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_pages, v_pages = write_kv_pages(
-            k_pages, v_pages, k, v, page_table, start, valid_len=lengths
+        kc, vc = write_kv_pages(
+            kc, vc, k, v, page_table, start, valid_len=lengths, layer=li
         )
         attn = causal_prefill_attention(q, k, v, lengths=lengths)
-        return attn.reshape(B, S, -1), k_pages, v_pages
+        return attn.reshape(B, S, -1), kc, vc
 
     x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -408,17 +405,17 @@ def prefill_with_prefix(
     cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)
 
-    def attn_fn(h, lp, k_pages, v_pages):
+    def attn_fn(h, lp, kc, vc, li):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_pages, v_pages = write_kv_pages(
-            k_pages, v_pages, k, v, page_table, start, valid_len=lengths
+        kc, vc = write_kv_pages(
+            kc, vc, k, v, page_table, start, valid_len=lengths, layer=li
         )
         attn = paged_prefix_attention(
-            q, k_pages, v_pages, page_table, start, lengths
+            q, kc, vc, page_table, start, lengths, layer=li
         )
-        return attn.reshape(B, S, -1), k_pages, v_pages
+        return attn.reshape(B, S, -1), kc, vc
 
     x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -447,18 +444,18 @@ def decode_step(
     x = params["embed"][tokens[:, None]].astype(dtype)  # [B, 1, D]
     valid = active.astype(jnp.int32)                   # [B] 1 new token if active
 
-    def attn_fn(h, lp, k_pages, v_pages):
+    def attn_fn(h, lp, kc, vc, li):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_pages, v_pages = write_kv_pages(
-            k_pages, v_pages, k, v, page_table, lengths, valid_len=valid
+        kc, vc = write_kv_pages(
+            kc, vc, k, v, page_table, lengths, valid_len=valid, layer=li
         )
         attn = paged_decode_attention_auto(
-            q[:, 0], k_pages, v_pages, page_table, lengths + valid,
-            impl=attn_impl,
+            q[:, 0], kc, vc, page_table, lengths + valid,
+            impl=attn_impl, layer=li,
         )
-        return attn.reshape(B, 1, -1), k_pages, v_pages
+        return attn.reshape(B, 1, -1), kc, vc
 
     x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -493,12 +490,12 @@ def forward_full(
     x = params["embed"][tokens].astype(dtype)
     attn_op = prefill_attn or causal_prefill_attention
 
-    def attn_fn(h, lp, k_pages, v_pages):
+    def attn_fn(h, lp, kc, vc, li):
         q, k, v = _qkv(h, lp, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         attn = attn_op(q, k, v)
-        return attn.reshape(B, S, -1), k_pages, v_pages
+        return attn.reshape(B, S, -1), kc, vc
 
     x, _, aux = _run_stack(params, cfg, x, attn_fn, cache=None, remat=remat)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)

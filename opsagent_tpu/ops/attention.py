@@ -46,6 +46,7 @@ def paged_decode_attention_auto(
     page_table: jax.Array,
     lengths: jax.Array,
     impl: str = "xla",
+    layer: jax.Array | None = None,
 ) -> jax.Array:
     """Impl-dispatched paged decode attention (impl from
     ``paged_attention_backend``, resolved at trace time by the caller)."""
@@ -53,9 +54,11 @@ def paged_decode_attention_auto(
         from .paged_attention_pallas import paged_decode_attention_pallas
 
         return paged_decode_attention_pallas(
-            q, k_pages, v_pages, page_table, lengths
+            q, k_pages, v_pages, page_table, lengths, layer=layer
         )
-    return paged_decode_attention(q, k_pages, v_pages, page_table, lengths)
+    return paged_decode_attention(
+        q, k_pages, v_pages, page_table, lengths, layer=layer
+    )
 
 
 def causal_prefill_attention(
@@ -94,49 +97,65 @@ def causal_prefill_attention(
 
 
 def write_kv_pages(
-    k_pages: jax.Array,     # [N, P, K, D]
-    v_pages: jax.Array,     # [N, P, K, D]
+    k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
+    v_pages: jax.Array,     # like k_pages
     k_new: jax.Array,       # [B, S, K, D]
     v_new: jax.Array,       # [B, S, K, D]
     page_table: jax.Array,  # [B, MaxP] int32 page indices (-1 = unassigned)
     start: jax.Array,       # [B] int32 write offset (tokens already in cache)
     valid_len: jax.Array | None = None,  # [B] number of valid new tokens
+    layer: jax.Array | None = None,  # [] int32 when pages carry a layer axis
 ) -> tuple[jax.Array, jax.Array]:
     """Scatter freshly-computed K/V into their sequences' pages.
 
     Token t of sequence b lands at flat slot ``page_table[b, (start[b]+t)//P]
-    * P + (start[b]+t) % P``. Out-of-range/padded tokens get an
+    * P + (start[b]+t) % P`` (offset by ``layer * N * P`` when the pages
+    carry a leading layer axis). Out-of-range/padded tokens get an
     out-of-bounds index and are dropped by the scatter (negative indices
-    would WRAP under JAX indexing semantics, so the sentinel is N*P).
+    would WRAP under JAX indexing semantics, so the sentinel is past-the-end).
+
+    The whole-cache-with-layer form exists so the layer stack can thread ONE
+    cache array through ``lax.scan`` as a loop carry: the scatter then
+    updates the carry in place, where per-layer stacked scan outputs would
+    copy the entire cache every step (~GBs/step at serving shapes).
     """
-    N, P, K, D = k_pages.shape
+    if k_pages.ndim == 5:
+        L, N, P, K, D = k_pages.shape
+        total = L * N
+        base = (layer if layer is not None else 0) * N
+    else:
+        N, P, K, D = k_pages.shape
+        total = N
+        base = 0
     B, S = k_new.shape[:2]
-    oob = N * P  # drop sentinel: one past the last flat slot
+    oob = total * P  # drop sentinel: one past the last flat slot
     pos = start[:, None] + jnp.arange(S)[None, :]          # [B, S]
     page_idx = jnp.take_along_axis(
         page_table, jnp.clip(pos // P, 0, page_table.shape[1] - 1), axis=1
     )                                                       # [B, S]
-    flat = page_idx * P + pos % P                           # [B, S]
+    flat = (page_idx + base) * P + pos % P                  # [B, S]
     if valid_len is not None:
         ok = jnp.arange(S)[None, :] < valid_len[:, None]
         flat = jnp.where(ok & (page_idx >= 0), flat, oob)
     else:
         flat = jnp.where(page_idx >= 0, flat, oob)
     flat = flat.reshape(B * S)
-    kf = k_pages.reshape(N * P, K, D)
-    vf = v_pages.reshape(N * P, K, D)
+    shape = k_pages.shape
+    kf = k_pages.reshape(total * P, K, D)
+    vf = v_pages.reshape(total * P, K, D)
     kf = kf.at[flat].set(k_new.reshape(B * S, K, D), mode="drop")
     vf = vf.at[flat].set(v_new.reshape(B * S, K, D), mode="drop")
-    return kf.reshape(N, P, K, D), vf.reshape(N, P, K, D)
+    return kf.reshape(shape), vf.reshape(shape)
 
 
 def paged_prefix_attention(
     q: jax.Array,           # [B, S, H, D] tail queries (right-padded)
-    k_pages: jax.Array,     # [N, P, K, D]
-    v_pages: jax.Array,     # [N, P, K, D]
+    k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
+    v_pages: jax.Array,     # like k_pages
     page_table: jax.Array,  # [B, MaxP]
     start: jax.Array,       # [B] cached-prefix lengths (tail begins here)
     lengths: jax.Array,     # [B] valid TAIL lengths
+    layer: jax.Array | None = None,  # [] int32 with the layer-axis form
 ) -> jax.Array:
     """Tail-prefill attention over paged KV holding [prefix + tail].
 
@@ -145,13 +164,22 @@ def paged_prefix_attention(
     every cached position t <= start + s. Gather-based XLA reference (the
     Pallas flash variant can come later — admission is not the steady-state
     hot loop the way decode is)."""
-    N, P, K, D = k_pages.shape
+    if k_pages.ndim == 5:
+        Lr, N, P, K, D = k_pages.shape
+        base = (layer if layer is not None else 0) * N
+        k_pages = k_pages.reshape(Lr * N, P, K, D)
+        v_pages = v_pages.reshape(Lr * N, P, K, D)
+        nmax = Lr * N - 1
+    else:
+        N, P, K, D = k_pages.shape
+        base = 0
+        nmax = N - 1
     B, S, H, _ = q.shape
     G = H // K
     MaxP = page_table.shape[1]
     L = MaxP * P
     scale = 1.0 / (D ** 0.5)
-    safe_table = jnp.clip(page_table, 0, N - 1)
+    safe_table = jnp.clip(page_table + base, 0, nmax)
     k_seq = k_pages[safe_table].reshape(B, L, K, D)
     v_seq = v_pages[safe_table].reshape(B, L, K, D)
     qg = q.reshape(B, S, K, G, D)
@@ -174,10 +202,11 @@ def paged_prefix_attention(
 
 def paged_decode_attention(
     q: jax.Array,           # [B, H, D] (one new token per sequence)
-    k_pages: jax.Array,     # [N, P, K, D]
-    v_pages: jax.Array,     # [N, P, K, D]
+    k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
+    v_pages: jax.Array,     # like k_pages
     page_table: jax.Array,  # [B, MaxP]
     lengths: jax.Array,     # [B] total tokens in cache (incl. the new one)
+    layer: jax.Array | None = None,  # [] int32 with the layer-axis form
 ) -> jax.Array:
     """Decode-step attention over paged KV (gather-based XLA reference).
 
@@ -185,12 +214,21 @@ def paged_decode_attention(
     masks positions >= length. The Pallas kernel avoids this materialized
     gather; results must match to ~1e-2 in bf16 / 1e-5 in f32.
     """
-    N, P, K, D = k_pages.shape
+    if k_pages.ndim == 5:
+        Lr, N, P, K, D = k_pages.shape
+        base = (layer if layer is not None else 0) * N
+        k_pages = k_pages.reshape(Lr * N, P, K, D)
+        v_pages = v_pages.reshape(Lr * N, P, K, D)
+        nmax = Lr * N - 1
+    else:
+        N, P, K, D = k_pages.shape
+        base = 0
+        nmax = N - 1
     B, H, _ = q.shape
     G = H // K
     MaxP = page_table.shape[1]
     scale = 1.0 / (D ** 0.5)
-    safe_table = jnp.clip(page_table, 0, N - 1)
+    safe_table = jnp.clip(page_table + base, 0, nmax)
     k_seq = k_pages[safe_table]                    # [B, MaxP, P, K, D]
     v_seq = v_pages[safe_table]
     L = MaxP * P

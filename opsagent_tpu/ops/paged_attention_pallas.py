@@ -38,6 +38,7 @@ def _kernel(
     # scalar prefetch
     table_ref,     # [B, MaxP] int32 page indices (-1 = unassigned)
     lengths_ref,   # [B] int32 tokens in cache (incl. the one being written)
+    base_ref,      # [1] int32 flat-page offset (layer * N; 0 without layers)
     # blocks
     q_ref,         # [1, H, D]
     k_ref,         # [1, P, K, D]   (one page, all kv heads)
@@ -114,43 +115,56 @@ def _kernel(
         o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
 
 
-def _page_index(b, p, table_ref, lengths_ref, *, page_size):
+def _page_index(b, p, table_ref, lengths_ref, base_ref, *, page_size):
     """Block index of the page to DMA for grid step (b, p); clamps
     past-the-end steps to the last valid page so the pipeline sees an
-    unchanged index and skips the refetch."""
+    unchanged index and skips the refetch. ``base_ref`` offsets into the
+    layer's region when the pages carry a flattened layer axis."""
     num_pages = pl.cdiv(lengths_ref[b], page_size)
     last = jnp.maximum(num_pages - 1, 0)
     page = table_ref[b, jnp.minimum(p, last)]
-    return (jnp.maximum(page, 0), 0, 0, 0)
+    return (jnp.maximum(page, 0) + base_ref[0], 0, 0, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(
     q: jax.Array,           # [B, H, D] (one new token per sequence)
-    k_pages: jax.Array,     # [N, P, K, D]
-    v_pages: jax.Array,     # [N, P, K, D]
+    k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
+    v_pages: jax.Array,     # like k_pages
     page_table: jax.Array,  # [B, MaxP] int32
     lengths: jax.Array,     # [B] int32 (incl. the token being decoded)
     interpret: bool = False,
+    layer: jax.Array | None = None,  # [] int32 with the layer-axis form
 ) -> jax.Array:
-    N, P, K, D = k_pages.shape
+    if k_pages.ndim == 5:
+        # Whole-cache form: flatten [L, N] -> [L*N] pages (free reshape) and
+        # offset the scalar-prefetched page lookups by layer * N, so the
+        # layer scan can carry ONE cache array without per-layer slicing.
+        Lr, N, P, K, D = k_pages.shape
+        k_pages = k_pages.reshape(Lr * N, P, K, D)
+        v_pages = v_pages.reshape(Lr * N, P, K, D)
+        base = (layer if layer is not None else 0) * N
+    else:
+        N, P, K, D = k_pages.shape
+        base = 0
     B, H, _ = q.shape
     MaxP = page_table.shape[1]
+    base_arr = jnp.full((1,), base, jnp.int32)
 
     page_map = functools.partial(_page_index, page_size=P)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, MaxP),
         in_specs=[
             pl.BlockSpec(
-                (1, H, D), lambda b, p, t, ln: (b, 0, 0),
+                (1, H, D), lambda b, p, t, ln, ba: (b, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (1, H, D), lambda b, p, t, ln: (b, 0, 0),
+            (1, H, D), lambda b, p, t, ln, ba: (b, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
@@ -172,5 +186,8 @@ def paged_decode_attention_pallas(
             ),
             transcendentals=B * H * MaxP * P,
         ),
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32), q, k_pages, v_pages)
+    )(
+        page_table.astype(jnp.int32), lengths.astype(jnp.int32), base_arr,
+        q, k_pages, v_pages,
+    )
     return out

@@ -29,6 +29,7 @@ Pipeline:
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Any, Iterable
 
@@ -293,6 +294,17 @@ def json_quote(value: Any) -> str:
 
 
 # -- Token-level FSM --------------------------------------------------------
+# The native matcher eagerly materializes [states, vocab] mask + destination
+# tables (~5 bytes/entry). A schemaless json_object DFA is ~14k states; at a
+# real 131k vocab that would be multi-GB, triggered by one API request — so
+# the eager path is gated on a total-entries budget and everything larger
+# stays on the lazy per-state numpy path (states actually visited during a
+# generation number in the dozens).
+NATIVE_TABLE_BUDGET = int(
+    os.environ.get("OPSAGENT_FSM_NATIVE_BUDGET", 64_000_000)
+)
+
+
 class TokenFSM:
     """Lifts a byte DFA to token-level masks over a tokenizer vocabulary.
 
@@ -315,18 +327,20 @@ class TokenFSM:
         for tid, tb in enumerate(token_bytes):
             if tb:
                 self._bytes[tid, : len(tb)] = np.frombuffer(tb, np.uint8)
-        # Native C++ tables when available: full eager precompute, O(row
-        # copy) per step. Falls back to the lazy numpy path silently.
+        # Native C++ tables when available AND within the memory budget:
+        # full eager precompute, O(row copy) per step. Falls back to the
+        # lazy numpy path silently.
         self._native = None
-        try:
-            from ..native import NativeFSMTables, get_lib
+        if dfa.num_states * self.vocab_size <= NATIVE_TABLE_BUDGET:
+            try:
+                from ..native import NativeFSMTables, get_lib
 
-            if get_lib() is not None:
-                self._native = NativeFSMTables(
-                    dfa.next, dfa.accept, token_bytes, eos_id
-                )
-        except Exception:  # noqa: BLE001 - fallback is always correct
-            self._native = None
+                if get_lib() is not None:
+                    self._native = NativeFSMTables(
+                        dfa.next, dfa.accept, token_bytes, eos_id
+                    )
+            except Exception:  # noqa: BLE001 - fallback is always correct
+                self._native = None
 
     def mask_for_state(self, state: int) -> np.ndarray:
         if self._native is not None:
@@ -376,25 +390,42 @@ class JsonConstraint:
         return self.fsm.mask_for_state(self._state)
 
 
+# Client-supplied schemas each pin a compiled TokenFSM ([vocab, maxlen]
+# byte matrix + per-state masks), so the per-tokenizer cache is a bounded
+# LRU, and schemas whose DFA explodes are rejected up front (the API maps
+# ValueError to HTTP 400).
+FSM_CACHE_CAPACITY = int(os.environ.get("OPSAGENT_FSM_CACHE_CAPACITY", 8))
+MAX_DFA_STATES = int(os.environ.get("OPSAGENT_FSM_MAX_STATES", 100_000))
+
+
 def json_constraint(
     tokenizer,
     schema: dict[str, Any] | None = None,
     depth: int = 4,
 ) -> JsonConstraint:
     """Build a fresh per-request constraint; the underlying TokenFSM is
-    cached per (schema, depth) ON the tokenizer object itself, so the cache
+    cached per (schema, depth) ON the tokenizer object itself — a bounded
+    LRU, so varied client schemas cannot grow memory without limit — and
     dies with its tokenizer (a global keyed on id() could go stale when
     CPython reuses a freed object's address)."""
     import json
 
     cache = tokenizer.__dict__.setdefault("_fsm_cache", {})
     key = (json.dumps(schema, sort_keys=True), depth)
-    fsm = cache.get(key)
+    fsm = cache.pop(key, None)
     if fsm is None:
         dfa = compile_regex(schema_to_regex(schema, depth))
+        if dfa.num_states > MAX_DFA_STATES:
+            raise ValueError(
+                f"json schema compiles to {dfa.num_states} DFA states "
+                f"(limit {MAX_DFA_STATES}); simplify the schema or reduce "
+                f"nesting depth"
+            )
         tb = [tokenizer.token_bytes(t) for t in range(tokenizer.vocab_size)]
         fsm = TokenFSM(dfa, tb, tokenizer.eos_id)
-        cache[key] = fsm
+    cache[key] = fsm  # (re)insert at the back = most recently used
+    while len(cache) > FSM_CACHE_CAPACITY:
+        cache.pop(next(iter(cache)))  # evict least recently used
     return JsonConstraint(fsm)
 
 

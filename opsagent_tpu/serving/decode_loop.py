@@ -54,7 +54,8 @@ def decode_block(
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
 ) -> tuple[jax.Array, Any, jax.Array]:
-    """Run ``n_steps`` fused decode+sample steps; returns
+    """One self-contained block: ``decode_block_carry`` with every lane
+    host-initialized (override all) and the carry discarded. Returns
     (tokens_out [B, n_steps] int32 — pad past a row's finish —, cache, key).
 
     ``greedy=True`` (trace-time) replaces the sampler with a bare argmax —
@@ -62,9 +63,67 @@ def decode_block(
     — because even a top-k candidate scan over a 128k vocab inside the
     decode loop costs several times the decode step itself on TPU.
     """
+    toks, cache, (_, _, _, key) = decode_block_carry(
+        params, cfg,
+        carry_tok=tokens, carry_at=write_at,
+        carry_eos=jnp.zeros_like(active), key=key,
+        override=jnp.ones_like(active), ov_tok=tokens, ov_at=write_at,
+        alive=active, budgets=budgets, cache=cache, page_table=page_table,
+        temps=temps, top_k=top_k, top_p=top_p,
+        eos_id=eos_id, pad_id=pad_id, n_steps=n_steps, greedy=greedy,
+        dtype=dtype, attn_impl=attn_impl,
+    )
+    return toks, cache, key
+
+
+def decode_block_carry(
+    params: Any,
+    cfg: ModelConfig,
+    # device-resident carry from the previous dispatch (or zeros):
+    carry_tok: jax.Array,   # [B] int32 last sampled (not yet written) token
+    carry_at: jax.Array,    # [B] int32 tokens already written to cache
+    carry_eos: jax.Array,   # [B] bool  row sampled EOS at some point
+    key: jax.Array,         # PRNG key (threaded through)
+    # host-supplied per-dispatch inputs:
+    override: jax.Array,    # [B] bool  lane newly (re)assigned: take ov_*
+    ov_tok: jax.Array,      # [B] int32
+    ov_at: jax.Array,       # [B] int32
+    alive: jax.Array,       # [B] bool  host wants this lane running
+    budgets: jax.Array,     # [B] int32 max tokens this dispatch may emit
+    cache: Any,             # paged KV pytree (donated)
+    page_table: jax.Array,  # [B, MaxP] pages pre-booked for the whole block
+    temps: jax.Array,       # [B] float32
+    top_k: jax.Array,       # [B] int32
+    top_p: jax.Array,       # [B] float32
+    eos_id: jax.Array,      # [] int32
+    pad_id: jax.Array,      # [] int32
+    n_steps: int,
+    greedy: bool = False,
+    dtype: jnp.dtype = jnp.bfloat16,
+    attn_impl: str = "xla",
+) -> tuple[jax.Array, Any, tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
+    """``decode_block`` with the loop state living ON DEVICE across
+    dispatches, so the host can enqueue block k+1 before pulling block k's
+    tokens (the pipelined engine path).
+
+    The host round trip is the throughput ceiling on tunneled/pod setups
+    (~70 ms here vs ~6 ms/step device compute); chaining dispatches through
+    the returned carry keeps the device busy while the previous block's
+    [B, n_steps] token pull and host bookkeeping overlap with compute.
+    The ``override`` lane lets the host splice in newly admitted sequences
+    (fresh token/write-offset) and ``alive`` lets it kill rows (stop
+    strings, cancellations) with one-dispatch lag; everything else — EOS
+    detection, per-dispatch budgets, KV writes — is decided on device.
+
+    Returns (tokens [B, n_steps], cache, new carry (tok, at, eos, key)).
+    """
+    tok = jnp.where(override, ov_tok, carry_tok).astype(jnp.int32)
+    at = jnp.where(override, ov_at, carry_at).astype(jnp.int32)
+    eos = jnp.where(override, False, carry_eos)
+    act0 = alive & ~eos & (budgets > 0)
 
     def body(carry, step_idx):
-        tok, at, act, cache, key = carry
+        tok, at, eos, act, cache, key = carry
         logits, cache = llama.decode_step(
             params, cfg, tok, at, cache, page_table, act,
             dtype=dtype, attn_impl=attn_impl,
@@ -74,14 +133,16 @@ def decode_block(
         else:
             key, sub = jax.random.split(key)
             nxt = sample(logits, sub, temps, top_k, top_p, None)
-        nxt = jnp.where(act, nxt, pad_id).astype(jnp.int32)
+        nxt = jnp.where(act, nxt, tok).astype(jnp.int32)
+        emitted = jnp.where(act, nxt, pad_id).astype(jnp.int32)
         at = at + act.astype(jnp.int32)
-        act = act & (nxt != eos_id) & (step_idx + 1 < budgets)
-        return (nxt, at, act, cache, key), nxt
+        eos = eos | (act & (nxt == eos_id))
+        act = act & ~eos & (step_idx + 1 < budgets)
+        return (nxt, at, eos, act, cache, key), emitted
 
-    (tok, at, act, cache, key), toks = jax.lax.scan(
+    (tok, at, eos, _, cache, key), toks = jax.lax.scan(
         body,
-        (tokens, write_at, active, cache, key),
+        (tok, at, eos, act0, cache, key),
         jnp.arange(n_steps),
     )
-    return toks.T, cache, key
+    return toks.T, cache, (tok, at, eos, key)

@@ -38,6 +38,14 @@ from .tokenizer import Tokenizer, load_tokenizer
 log = get_logger("engine")
 
 
+def _merge_pulls(out: dict[int, list[int]], pulled: dict[int, list[int]]) -> None:
+    """Fold one pulled block's tokens into an accumulated result. Plain
+    dict.update would REPLACE a sequence's list when several pulled blocks
+    carry tokens for it (multi-block drains), dropping tokens."""
+    for sid, toks in pulled.items():
+        out.setdefault(sid, []).extend(toks)
+
+
 @dataclass
 class EngineConfig:
     model: str = "tiny-test"
@@ -53,7 +61,13 @@ class EngineConfig:
     # Decode steps fused into one device dispatch (1 = step-at-a-time).
     # Each dispatch costs a host->device round trip plus ONE device->host
     # token pull, so per-token overhead scales as RTT / decode_block.
-    decode_block: int = 16
+    decode_block: int = 32
+    # Dispatches allowed in flight beyond the one being pulled. With the
+    # decode loop state device-resident (decode_loop.decode_block_carry),
+    # block k+1..k+depth are enqueued before block k's tokens are pulled,
+    # so the pull RTT and host bookkeeping overlap device compute. 0 =
+    # synchronous (pull immediately after each dispatch).
+    pipeline_depth: int = 2
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
     max_new_tokens_default: int = 1024
     seed: int = 0
@@ -153,15 +167,17 @@ class Engine:
             tok = sample(logits, key, temps, top_k, top_p, mask)
             return tok.astype(jnp.int32), cache
 
-        def _decode_block(
-            params, tokens, write_at, active, budgets, cache, table,
-            key, temps, top_k, top_p, greedy,
+        def _decode_pipeline(
+            params, carry_tok, carry_at, carry_eos, key,
+            override, ov_tok, ov_at, alive, budgets, cache, table,
+            temps, top_k, top_p, greedy,
         ):
-            from .decode_loop import decode_block
+            from .decode_loop import decode_block_carry
 
-            return decode_block(
-                params, mc, tokens, write_at, active, budgets, cache, table,
-                key, temps, top_k, top_p,
+            return decode_block_carry(
+                params, mc, carry_tok, carry_at, carry_eos, key,
+                override, ov_tok, ov_at, alive, budgets, cache, table,
+                temps, top_k, top_p,
                 jnp.int32(self.tokenizer.eos_id),
                 jnp.int32(self.tokenizer.pad_id),
                 n_steps=self.cfg.decode_block,
@@ -177,10 +193,22 @@ class Engine:
         self._decode_sample_jit = jax.jit(
             _decode_sample, donate_argnames=("cache",)
         )
-        self._decode_block_jit = jax.jit(
-            _decode_block, donate_argnames=("cache",), static_argnames=("greedy",)
+        self._decode_pipeline_jit = jax.jit(
+            _decode_pipeline,
+            donate_argnames=("cache", "carry_tok", "carry_at", "carry_eos", "key"),
+            static_argnames=("greedy",),
         )
         self._sample_jit = jax.jit(sample)
+
+        # -- pipelined decode state (see step_block) -------------------------
+        B = cfg.max_batch_size
+        self._lanes: list[int | None] = [None] * B   # lane -> seq_id
+        self._lane_of: dict[int, int] = {}           # seq_id -> lane
+        self._carry: tuple | None = None             # device (tok, at, eos, key)
+        from collections import deque
+
+        self._inflight: deque = deque()              # dispatched, unpulled
+        self._inflight_steps: dict[int, int] = {}    # seq_id -> booked steps
 
     # -- bucketing ---------------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -346,10 +374,95 @@ class Engine:
         tail = self.tokenizer.decode(tail_tokens)
         return any(s in tail for s in seq.params.stop)
 
+    # -- pipelined decode internals ------------------------------------------
+    def _host_written(self, seq: Sequence) -> int:
+        """Tokens actually written to this sequence's pages: the prompt plus
+        every accepted token except the last sampled one (never written)."""
+        return seq.prompt_len + max(0, len(seq.tokens) - 1)
+
+    def _free_lane(self, seq_id: int) -> None:
+        lane = self._lane_of.pop(seq_id, None)
+        if lane is not None:
+            self._lanes[lane] = None
+
+    def _flush_and_invalidate(self) -> None:
+        """Drain in-flight dispatches and drop the device-resident decode
+        state, rolling page bookings back to written content. Called before
+        the legacy single-step path touches a lane-held sequence (its
+        extend/truncate bookkeeping would desync the device carry)."""
+        while self._inflight:
+            self._pull_oldest()
+        for sid in list(self._lane_of):
+            s = self.sequences.get(sid)
+            if s is not None and not s.done:
+                self.alloc.truncate(sid, self._host_written(s))
+        self._lanes = [None] * self.cfg.max_batch_size
+        self._lane_of.clear()
+        self._carry = None
+
+    def _pull_oldest(self) -> dict[int, list[int]]:
+        """Pull the oldest in-flight block's tokens (the one device->host
+        round trip per dispatch) and fold them into host state. Records are
+        pulled FIFO, so the host always sees a row's EOS before any of its
+        later pad-only blocks."""
+        toks_d, lane_seqs, budgets = self._inflight.popleft()
+        perf = get_perf_stats()
+        t0 = time.perf_counter()
+        toks = np.asarray(toks_d)
+        perf.record_metric(
+            "engine.block_pull", (time.perf_counter() - t0) * 1e3, "ms"
+        )
+        out: dict[int, list[int]] = {}
+        produced = 0
+        first_exc: BaseException | None = None
+        for lane, sid in enumerate(lane_seqs):
+            if sid is None or budgets[lane] == 0:
+                continue
+            left = self._inflight_steps.get(sid, 0) - int(budgets[lane])
+            if left > 0:
+                self._inflight_steps[sid] = left
+            else:
+                self._inflight_steps.pop(sid, None)
+            s = self.sequences.get(sid)
+            if s is None or s.done:
+                continue  # finished/vanished while this block was in flight
+            n0 = len(s.tokens)
+            try:
+                for j in range(int(budgets[lane])):
+                    self._accept_token(s, int(toks[lane, j]))
+                    if s.done:
+                        break
+            except Exception as e:  # noqa: BLE001 - raising stream callback
+                if first_exc is None:
+                    first_exc = e
+                s.done = True
+                s.finish_reason = s.finish_reason or "error"
+            finally:
+                accepted = s.tokens[n0:]
+                out[sid] = accepted
+                produced += len(accepted)
+                if s.done:
+                    # Roll pre-booked pages back to written content. Any
+                    # still-in-flight dispatch may keep writing to the freed
+                    # pages, but device execution is in dispatch order: a
+                    # future owner's writes always land after the stale
+                    # ones, so reuse is safe without draining.
+                    self.alloc.truncate(sid, self._host_written(s))
+                    self._free_lane(sid)
+        perf.record_metric("engine.decode_tokens", produced, "tok")
+        if first_exc is not None:
+            raise first_exc
+        return out
+
     def step(self, seq_ids: list[int] | None = None) -> dict[int, int]:
         """One decode step over up to max_batch_size running sequences.
         Returns {seq_id: new_token} for sequences that advanced."""
         with self.lock:
+            targets = (
+                list(self.sequences) if seq_ids is None else list(seq_ids)
+            )
+            if any(sid in self._lane_of for sid in targets):
+                self._flush_and_invalidate()
             running = [
                 s for s in self.sequences.values() if not s.done
             ] if seq_ids is None else [
@@ -414,12 +527,18 @@ class Engine:
             return out
 
     def step_block(self, seq_ids: list[int] | None = None) -> dict[int, list[int]]:
-        """Advance running sequences by up to ``cfg.decode_block`` tokens in
-        ONE device dispatch (one token pull per block instead of per step).
+        """Advance running sequences by up to ``cfg.decode_block`` tokens per
+        device dispatch, keeping ``cfg.pipeline_depth`` dispatches in flight:
+        the decode loop state (last token, write offset, EOS flags, PRNG key)
+        lives ON DEVICE (decode_loop.decode_block_carry), so block k+1 is
+        enqueued before block k's tokens are pulled and the pull RTT overlaps
+        device compute. Tokens are therefore reported up to ``depth`` blocks
+        after they were generated.
+
         Rows with a constrained-decoding mask advance one fused step per
-        call instead (masks are host-computed per token); unconstrained
-        rows in the same batch still block-decode. Returns
-        {seq_id: accepted tokens} for sequences that advanced."""
+        call instead (masks are host-computed per token); unconstrained rows
+        in the same batch still pipeline. Returns {seq_id: accepted tokens}
+        for sequences that advanced this call."""
         with self.lock:
             running = [
                 s for s in self.sequences.values() if not s.done
@@ -427,125 +546,175 @@ class Engine:
                 self.sequences[i] for i in seq_ids if not self.sequences[i].done
             ]
             running = running[: self.cfg.max_batch_size]
-            if not running:
-                return {}
             block = self.cfg.decode_block
             masked = [s for s in running if s.mask_fn is not None]
             plain = [s for s in running if s.mask_fn is None]
-            if block <= 1 or (masked and not plain):
+            if running and (block <= 1 or (masked and not plain)):
                 return {
                     sid: [tok]
                     for sid, tok in self.step(
                         [s.seq_id for s in running]
                     ).items()
                 }
-            out_masked: dict[int, list[int]] = {}
+            out: dict[int, list[int]] = {}
             if masked:
                 # Mixed batch: constrained rows need a host-computed logits
                 # mask per token, so they advance one fused step per call
-                # while the unconstrained rows still block-decode. (Their
-                # inter-token latency grows by the block's device time —
-                # the device-side FSM is the planned fix.)
-                out_masked = {
+                # (step() does not flush the pipeline for lane-less masked
+                # rows) while the unconstrained rows pipeline underneath.
+                out.update({
                     sid: [tok]
                     for sid, tok in self.step(
                         [s.seq_id for s in masked]
                     ).items()
-                }
-                running = [s for s in plain if not s.done]
-                if not running:
-                    return out_masked
+                })
+                plain = [s for s in plain if not s.done]
             B = self.cfg.max_batch_size
-            # Pre-book pages for the whole block; rows that cannot grow at
-            # all right now are truncated (consistent with step()).
-            grown: list[Sequence] = []
-            budgets: list[int] = []
-            base_len: list[int] = []
-            for s in running:
-                want = min(block, s.params.max_tokens - len(s.tokens))
-                want = max(want, 1)
-                before = self.alloc.length(s.seq_id)
-                got = self.alloc.extend_upto(s.seq_id, want)
+            # Lane sync: free lanes of finished sequences, then seat newly
+            # running ones. A lane holds its sequence for its whole life, so
+            # the device carry stays valid across dispatches.
+            for lane, sid in enumerate(self._lanes):
+                if sid is None:
+                    continue
+                s = self.sequences.get(sid)
+                if s is None or s.done:
+                    self._lanes[lane] = None
+                    self._lane_of.pop(sid, None)
+            override = np.zeros((B,), bool)
+            ov_tok = np.zeros((B,), np.int32)
+            ov_at = np.zeros((B,), np.int32)
+            for s in plain:
+                if s.seq_id in self._lane_of:
+                    continue
+                try:
+                    lane = self._lanes.index(None)
+                except ValueError:
+                    break  # more running sequences than lanes: they wait
+                self._lanes[lane] = s.seq_id
+                self._lane_of[s.seq_id] = lane
+                override[lane] = True
+                ov_tok[lane] = s.tokens[-1] if s.tokens else self.tokenizer.bos_id
+                # Invariant at (re)seating: alloc.length == written tokens.
+                ov_at[lane] = self.alloc.length(s.seq_id)
+            # Book pages for up to one block per lane; budgets account for
+            # still-in-flight dispatches so max_tokens is never overshot.
+            # Seated lanes OUTSIDE the caller's seq_ids filter keep their
+            # device carry but get no budget — they do not advance.
+            requested = {s.seq_id for s in plain}
+            alive = np.zeros((B,), bool)
+            budgets = np.zeros((B,), np.int32)
+            lane_seqs: list[int | None] = [None] * B
+            for lane, sid in enumerate(self._lanes):
+                if sid is None:
+                    continue
+                if sid not in requested:
+                    alive[lane] = True
+                    lane_seqs[lane] = sid
+                    continue
+                s = self.sequences[sid]
+                want = min(
+                    block,
+                    s.params.max_tokens - len(s.tokens)
+                    - self._inflight_steps.get(sid, 0),
+                )
+                if want <= 0:
+                    # Budget fully covered by in-flight blocks: keep the
+                    # lane seated, dispatch nothing for it.
+                    alive[lane] = True
+                    lane_seqs[lane] = sid
+                    continue
+                got = self.alloc.extend_upto(sid, want)
+                if got == 0:
+                    # Page pool dry. Before killing the row, drain the
+                    # pipeline: its in-flight blocks may hold legitimately
+                    # generated tokens for this sequence (discarding them
+                    # would truncate the response early), and their pulls
+                    # roll back other finished rows' pages — which can make
+                    # this extend succeed after all.
+                    while self._inflight:
+                        _merge_pulls(out, self._pull_oldest())
+                    if s.done:
+                        continue  # drained blocks finished it (EOS/stop)
+                    got = self.alloc.extend_upto(sid, want)
                 if got == 0:
                     s.done = True
                     s.finish_reason = "length"
+                    self.alloc.truncate(sid, self._host_written(s))
+                    self._free_lane(sid)
+                    override[lane] = False
                     log.warning(
-                        "seq %d truncated: KV page budget exhausted", s.seq_id
+                        "seq %d truncated: KV page budget exhausted", sid
                     )
                     continue
-                grown.append(s)
-                budgets.append(got)
-                base_len.append(before)
-            if not grown:
-                return out_masked
-            ids: list[int | None] = [s.seq_id for s in grown]
-            ids += [None] * (B - len(ids))
-            table, _, active = self.alloc.batch_views(ids, B)
-            write_at = np.zeros((B,), np.int32)
-            budget_arr = np.zeros((B,), np.int32)
-            tokens = np.zeros((B,), np.int32)
-            for i, s in enumerate(grown):
-                write_at[i] = base_len[i]
-                budget_arr[i] = budgets[i]
-                tokens[i] = s.tokens[-1] if s.tokens else self.tokenizer.bos_id
-            slots = grown + [None] * (B - len(grown))
+                alive[lane] = True
+                budgets[lane] = got
+                lane_seqs[lane] = sid
+            if not budgets.any():
+                # Nothing to dispatch; a pull still guarantees progress.
+                if self._inflight:
+                    _merge_pulls(out, self._pull_oldest())
+                return out
+            table, _, _ = self.alloc.batch_views(lane_seqs, B)
+            slots = [
+                self.sequences.get(sid) if sid is not None else None
+                for sid in lane_seqs
+            ]
             temps, top_k, top_p, _ = self._sampling_arrays(slots, B)
             greedy = bool(np.all(temps <= 0.0))
+            if self._carry is None:
+                # Fork the decode-loop PRNG stream off the admission stream
+                # so per-step sampling never reuses an admission key.
+                self._sample_key, carry_key = jax.random.split(self._sample_key)
+                # Distinct arrays: all four are donated, and donating the
+                # same buffer twice is an error.
+                self._carry = (
+                    jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), bool),
+                    carry_key,
+                )
+            c_tok, c_at, c_eos, c_key = self._carry
             perf = get_perf_stats()
             t_disp = time.perf_counter()
             with self.mesh:
-                toks, self.cache, self._sample_key = self._decode_block_jit(
+                toks, self.cache, self._carry = self._decode_pipeline_jit(
                     self.params,
-                    jnp.asarray(tokens),
-                    jnp.asarray(write_at),
-                    jnp.asarray(active),
-                    jnp.asarray(budget_arr),
+                    c_tok, c_at, c_eos, c_key,
+                    jnp.asarray(override),
+                    jnp.asarray(ov_tok),
+                    jnp.asarray(ov_at),
+                    jnp.asarray(alive),
+                    jnp.asarray(budgets),
                     self.cache,
                     jnp.asarray(table),
-                    self._sample_key,
                     jnp.asarray(temps),
                     jnp.asarray(top_k),
                     jnp.asarray(top_p),
                     greedy=greedy,
                 )
-            t_pull = time.perf_counter()
-            toks = np.asarray(toks)  # the ONE device->host pull per block
-            t_done = time.perf_counter()
             perf.record_metric(
-                "engine.block_dispatch", (t_pull - t_disp) * 1e3, "ms"
+                "engine.block_dispatch", (time.perf_counter() - t_disp) * 1e3,
+                "ms",
             )
-            perf.record_metric("engine.block_pull", (t_done - t_pull) * 1e3, "ms")
-            out: dict[int, list[int]] = dict(out_masked)
-            produced = 0
-            first_exc: BaseException | None = None
-            for i, s in enumerate(grown):
-                n0 = len(s.tokens)
-                try:
-                    for j in range(budgets[i]):
-                        self._accept_token(s, int(toks[i, j]))
-                        if s.done:
-                            break
-                except Exception as e:  # noqa: BLE001 - raising stream cb
-                    # A raising stream callback must not skip the page
-                    # rollback (that would poison the prefix cache with
-                    # pages whose KV content outruns the accepted tokens).
-                    if first_exc is None:
-                        first_exc = e
-                    s.done = True
-                    s.finish_reason = s.finish_reason or "error"
-                finally:
-                    accepted = s.tokens[n0:]
-                    # Roll the pre-booked pages back to what was accepted:
-                    # the cache holds [prompt + generated[:-1]] (the last
-                    # sampled token is never written) = base_len + accepted.
-                    self.alloc.truncate(
-                        s.seq_id, base_len[i] + len(accepted)
+            self._inflight.append((toks, lane_seqs, budgets))
+            for sid, b in zip(lane_seqs, budgets):
+                if sid is not None and b:
+                    self._inflight_steps[sid] = (
+                        self._inflight_steps.get(sid, 0) + int(b)
                     )
-                    out[s.seq_id] = accepted
-                    produced += len(accepted)
-            get_perf_stats().record_metric("engine.decode_tokens", produced, "tok")
-            if first_exc is not None:
-                raise first_exc
+            while len(self._inflight) > self.cfg.pipeline_depth:
+                _merge_pulls(out, self._pull_oldest())
+            return out
+
+    def drain(self) -> dict[int, list[int]]:
+        """Pull every in-flight decode dispatch and fold the tokens into
+        host state. Call before reading final sequence state outside the
+        step loop (benchmarks, shutdown); the step loop itself drains
+        incrementally."""
+        with self.lock:
+            out: dict[int, list[int]] = {}
+            while self._inflight:
+                _merge_pulls(out, self._pull_oldest())
             return out
 
     def finish(self, seq_id: int) -> list[int]:

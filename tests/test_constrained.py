@@ -192,3 +192,57 @@ class TestEngineWiring:
             assert ei.value.status == 400
         finally:
             stack.close()
+
+
+class TestFSMCacheBounds:
+    """Advisor findings: client-supplied schemas must not grow server memory
+    without limit, and pathological schemas must be rejected with a 400-class
+    error instead of compiling multi-GB tables."""
+
+    def test_cache_is_lru_bounded(self):
+        from opsagent_tpu.serving import constrained as C
+
+        tok = ByteTokenizer()
+        for i in range(C.FSM_CACHE_CAPACITY + 4):
+            json_constraint(tok, {"type": "object", "properties": {
+                f"key{i}": {"type": "string"},
+            }})
+        cache = tok.__dict__["_fsm_cache"]
+        assert len(cache) == C.FSM_CACHE_CAPACITY
+
+    def test_lru_keeps_recently_used(self):
+        from opsagent_tpu.serving import constrained as C
+
+        tok = ByteTokenizer()
+        first = {"type": "object", "properties": {"keep": {"type": "string"}}}
+        json_constraint(tok, first)
+        fsm_first = next(iter(tok.__dict__["_fsm_cache"].values()))
+        for i in range(C.FSM_CACHE_CAPACITY - 1):
+            json_constraint(tok, {"enum": [f"v{i}"]})
+        json_constraint(tok, first)  # touch: moves to MRU
+        json_constraint(tok, {"enum": ["evictor"]})  # evicts true LRU
+        assert fsm_first in tok.__dict__["_fsm_cache"].values()
+
+    def test_oversized_schema_rejected(self, monkeypatch):
+        from opsagent_tpu.serving import constrained as C
+
+        monkeypatch.setattr(C, "MAX_DFA_STATES", 10)
+        tok = ByteTokenizer()
+        with pytest.raises(ValueError, match="DFA states"):
+            C.json_constraint(tok, None, depth=3)
+
+    def test_native_tables_gated_on_budget(self, monkeypatch):
+        """A DFA whose [states, vocab] tables exceed the budget must stay on
+        the lazy numpy path (the eager native precompute at a 131k vocab
+        would allocate GBs for the schemaless json_object DFA)."""
+        from opsagent_tpu.serving import constrained as C
+
+        monkeypatch.setattr(C, "NATIVE_TABLE_BUDGET", 0)
+        tok = ByteTokenizer()
+        dfa = C.compile_regex(C.schema_to_regex({"type": "boolean"}))
+        tb = [tok.token_bytes(t) for t in range(tok.vocab_size)]
+        fsm = C.TokenFSM(dfa, tb, tok.eos_id)
+        assert fsm._native is None
+        # Masks still work via the lazy path.
+        mask = fsm.mask_for_state(dfa.start)
+        assert mask[ord("t")] and mask[ord("f")] and not mask[ord("x")]

@@ -252,3 +252,44 @@ def test_step_block_raising_stream_rolls_back_pages(engine):
     assert engine.alloc.length(sid) == seq.prompt_len + len(seq.tokens) - 1
     engine.finish(sid)
     assert engine.alloc.free_pages == free_before
+
+
+def test_step_block_seq_ids_filter_only_advances_requested(engine):
+    """With both sequences lane-seated, step_block([a]) must not advance b
+    (its lane keeps the device carry but gets no budget)."""
+    a = engine.add_request([257, 1, 2, 3], SamplingParams(max_tokens=12))
+    b = engine.add_request([257, 4, 5, 6], SamplingParams(max_tokens=12))
+    engine.step_block([a, b])  # seat both lanes
+    engine.drain()             # settle the seating dispatch's tokens
+    n_b = len(engine.sequences[b].tokens)
+    for _ in range(6):
+        if engine.sequences[a].done:
+            break
+        engine.step_block([a])
+    engine.drain()
+    assert len(engine.sequences[b].tokens) == n_b
+    # b still advances fine afterwards.
+    while not (engine.sequences[a].done and engine.sequences[b].done):
+        engine.step_block([a, b])
+    engine.finish(a)
+    engine.finish(b)
+
+
+def test_drain_merges_multi_block_pulls(engine):
+    """drain() pulling several in-flight blocks for the same sequence must
+    concatenate their tokens, not keep only the last block's."""
+    want = engine.generate([[257, 8, 9]], SamplingParams(max_tokens=40))[0]
+    sid = engine.add_request([257, 8, 9], SamplingParams(max_tokens=40))
+    collected = list(engine.sequences[sid].tokens)  # admission's first token
+    # Fill the pipeline without pulling everything, then drain.
+    for _ in range(4):
+        out = engine.step_block([sid])
+        collected.extend(out.get(sid, []))
+    collected.extend(engine.drain().get(sid, []))
+    while not engine.sequences[sid].done:
+        out = engine.step_block([sid])
+        collected.extend(out.get(sid, []))
+    collected.extend(engine.drain().get(sid, []))
+    got = engine.finish(sid)
+    assert got == want
+    assert collected == want

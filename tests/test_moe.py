@@ -161,3 +161,46 @@ def test_sharded_moe_training_step():
     params, opt_state, metrics = step(params, opt_state, tokens, mask)
     loss = float(metrics["loss"])
     assert np.isfinite(loss)
+
+
+class TestCombineWeightSemantics:
+    """Combine-weight flags must match the checkpoint's HF config: DeepSeek-
+    MoE-16B/V2-Lite ship norm_topk_prob=false (raw softmax probs); V3 ships
+    norm_topk_prob=true with routed_scaling_factor=2.5 (advisor finding:
+    unconditional renormalization corrupts DeepSeek-16B generation)."""
+
+    def _outputs(self, flags, h, params):
+        from dataclasses import replace
+
+        cfg = replace(CFG, moe=replace(CFG.moe, **flags))
+        lp = jax.tree.map(lambda a: a[0], params["moe_layers"])
+        out, _ = llama._moe_mlp(h, lp, cfg)
+        return np.asarray(out)
+
+    def test_raw_vs_renormalized_differ_by_topk_mass(self, params):
+        h = jax.random.normal(
+            jax.random.PRNGKey(3), (2, 4, CFG.hidden_size), jnp.float32
+        )
+        raw = self._outputs({"norm_topk_prob": False}, h, params)
+        renorm = self._outputs({"norm_topk_prob": True}, h, params)
+        # Renormalization divides combine weights by sum(top-k probs) < 1,
+        # so the routed contribution grows; outputs must differ.
+        assert not np.allclose(raw, renorm)
+
+    def test_routed_scaling_factor_scales_routed_path(self, params):
+        h = jax.random.normal(
+            jax.random.PRNGKey(4), (1, 3, CFG.hidden_size), jnp.float32
+        )
+        base = self._outputs({}, h, params)
+        scaled = self._outputs({"routed_scaling_factor": 2.5}, h, params)
+        # Shared-expert path is unscaled; isolate the routed path by diff.
+        shared_only = self._outputs({"routed_scaling_factor": 0.0}, h, params)
+        np.testing.assert_allclose(
+            scaled - shared_only, 2.5 * (base - shared_only),
+            rtol=2e-5, atol=2e-6,
+        )
+
+    def test_deepseek_16b_preset_uses_raw_probs(self):
+        cfg = get_config_preset("deepseek-moe-16b")
+        assert cfg.moe.norm_topk_prob is False
+        assert cfg.moe.routed_scaling_factor == 1.0

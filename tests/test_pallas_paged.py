@@ -121,8 +121,8 @@ def test_decode_step_with_pallas_impl_matches_xla():
     # Force interpret mode inside the pallas path for the CPU test.
     orig = pp.paged_decode_attention_pallas
 
-    def interp(q, k, v, t, ln, interpret=False):
-        return orig(q, k, v, t, ln, interpret=True)
+    def interp(q, k, v, t, ln, interpret=False, layer=None):
+        return orig(q, k, v, t, ln, interpret=True, layer=layer)
 
     pp.paged_decode_attention_pallas = interp
     try:
