@@ -435,6 +435,7 @@ def decode_step(
     active: jax.Array,       # [B] bool; inactive slots skip the page write
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",  # "xla" | "pallas" (ops.paged_attention_backend)
+    mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
 ) -> tuple[jax.Array, Params]:
     """One decode step for a batch of sequences; returns ([B, V] logits,
     updated cache)."""
@@ -453,7 +454,7 @@ def decode_step(
         )
         attn = paged_decode_attention_auto(
             q[:, 0], kc, vc, page_table, lengths + valid,
-            impl=attn_impl, layer=li,
+            impl=attn_impl, layer=li, mesh=mesh,
         )
         return attn.reshape(B, 1, -1), kc, vc
 

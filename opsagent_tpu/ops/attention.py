@@ -14,21 +14,23 @@ the Ragged-Paged-Attention design in PAPERS.md).
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
 
 
-def paged_attention_backend(tp: int = 1) -> str:
+def paged_attention_backend() -> str:
     """Which decode-attention implementation to use: "pallas" (TPU kernel)
     or "xla" (gather-based reference). Env OPSAGENT_PAGED_BACKEND overrides;
-    default picks the Pallas kernel on TPU when the program is not
-    tensor-parallel-sharded (a bare pallas_call is opaque to the pjit
-    partitioner; the tp>1 path keeps the XLA reference until the kernel is
-    shard_map-wrapped)."""
+    default picks the Pallas kernel on TPU regardless of tensor parallelism
+    — under tp the kernel runs inside a shard_map over the tp axis (kv
+    heads are tp-sharded, so each device streams only its own heads'
+    pages)."""
     choice = os.environ.get("OPSAGENT_PAGED_BACKEND", "auto")
     if choice in ("pallas", "xla"):
         return choice
@@ -36,7 +38,69 @@ def paged_attention_backend(tp: int = 1) -> str:
         raise ValueError(
             f"OPSAGENT_PAGED_BACKEND={choice!r}: expected pallas, xla, or auto"
         )
-    return "pallas" if (jax.default_backend() == "tpu" and tp == 1) else "xla"
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    # check_vma/check_rep off: pallas_call does not annotate its outputs'
+    # varying-mesh-axes metadata, and the head axis is fully data-parallel
+    # here (no cross-shard reduction to validate anyway).
+    try:
+        from jax import shard_map
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
+def paged_decode_attention_pallas_tp(
+    q: jax.Array,           # [B, H, D] — H sharded over tp
+    k_pages: jax.Array,     # [N, P, K, D] or [L, N, P, K, D] — K over tp
+    v_pages: jax.Array,     # like k_pages
+    page_table: jax.Array,  # [B, MaxP] replicated
+    lengths: jax.Array,     # [B] replicated
+    mesh: Mesh,
+    layer: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """The Pallas decode kernel under tensor parallelism.
+
+    A bare pallas_call is opaque to the pjit partitioner, so it is wrapped
+    in shard_map over the ``tp`` mesh axis: q's heads and the KV pages' kv
+    heads are both tp-sharded (models.llama param/cache specs), every
+    device runs the kernel on its own H/tp query heads against its own
+    K/tp kv heads — the GQA group structure is preserved per shard and NO
+    collective is needed (the head axis is fully data-parallel here; the
+    all-reduce happens later at the wo row-parallel matmul)."""
+    from .paged_attention_pallas import paged_decode_attention_pallas
+
+    spec_q = P(None, "tp", None)
+    spec_kv = (
+        P(None, None, None, "tp", None) if k_pages.ndim == 5
+        else P(None, None, "tp", None)
+    )
+    if layer is None:
+        layer = jnp.int32(0)
+
+    def local(q, kp, vp, table, ln, ly):
+        return paged_decode_attention_pallas(
+            q, kp, vp, table, ln, interpret=interpret, layer=ly
+        )
+
+    mapped = _shard_map(
+        local, mesh,
+        in_specs=(spec_q, spec_kv, spec_kv, P(None, None), P(None), P()),
+        out_specs=spec_q,
+    )
+    return mapped(q, k_pages, v_pages, page_table, lengths, layer)
 
 
 def paged_decode_attention_auto(
@@ -47,10 +111,17 @@ def paged_decode_attention_auto(
     lengths: jax.Array,
     impl: str = "xla",
     layer: jax.Array | None = None,
+    mesh: Mesh | None = None,
 ) -> jax.Array:
     """Impl-dispatched paged decode attention (impl from
-    ``paged_attention_backend``, resolved at trace time by the caller)."""
+    ``paged_attention_backend``, resolved at trace time by the caller).
+    With a mesh whose tp axis is >1, the Pallas path runs shard_mapped
+    over tp (see ``paged_decode_attention_pallas_tp``)."""
     if impl == "pallas":
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            return paged_decode_attention_pallas_tp(
+                q, k_pages, v_pages, page_table, lengths, mesh, layer=layer
+            )
         from .paged_attention_pallas import paged_decode_attention_pallas
 
         return paged_decode_attention_pallas(

@@ -425,7 +425,9 @@ def json_constraint(
         fsm = TokenFSM(dfa, tb, tokenizer.eos_id)
     cache[key] = fsm  # (re)insert at the back = most recently used
     while len(cache) > FSM_CACHE_CAPACITY:
-        cache.pop(next(iter(cache)))  # evict least recently used
+        # Default-tolerant pop: concurrent requests (no lock on this path)
+        # may race the same LRU key; losing the race must not raise.
+        cache.pop(next(iter(cache)), None)
     return JsonConstraint(fsm)
 
 

@@ -53,6 +53,7 @@ def decode_block(
     greedy: bool = False,
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
+    mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
 ) -> tuple[jax.Array, Any, jax.Array]:
     """One self-contained block: ``decode_block_carry`` with every lane
     host-initialized (override all) and the carry discarded. Returns
@@ -71,7 +72,7 @@ def decode_block(
         alive=active, budgets=budgets, cache=cache, page_table=page_table,
         temps=temps, top_k=top_k, top_p=top_p,
         eos_id=eos_id, pad_id=pad_id, n_steps=n_steps, greedy=greedy,
-        dtype=dtype, attn_impl=attn_impl,
+        dtype=dtype, attn_impl=attn_impl, mesh=mesh,
     )
     return toks, cache, key
 
@@ -101,6 +102,7 @@ def decode_block_carry(
     greedy: bool = False,
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
+    mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
 ) -> tuple[jax.Array, Any, tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
     """``decode_block`` with the loop state living ON DEVICE across
     dispatches, so the host can enqueue block k+1 before pulling block k's
@@ -126,7 +128,7 @@ def decode_block_carry(
         tok, at, eos, act, cache, key = carry
         logits, cache = llama.decode_step(
             params, cfg, tok, at, cache, page_table, act,
-            dtype=dtype, attn_impl=attn_impl,
+            dtype=dtype, attn_impl=attn_impl, mesh=mesh,
         )
         if greedy:
             nxt = jnp.argmax(logits, axis=-1)

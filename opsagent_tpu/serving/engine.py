@@ -144,8 +144,13 @@ class Engine:
         mc, dt = self.model_cfg, cfg.dtype
         from ..ops.attention import paged_attention_backend
 
-        self.attn_impl = paged_attention_backend(tp=tp)
-        log.info("paged decode attention impl: %s (tp=%d)", self.attn_impl, tp)
+        self.attn_impl = paged_attention_backend()
+        log.info(
+            "paged decode attention impl: %s (tp=%d%s)",
+            self.attn_impl, tp,
+            ", shard_map over tp" if self.attn_impl == "pallas" and tp > 1
+            else "",
+        )
 
         def _prefill(params, tokens, lengths, cache, table):
             return llama.prefill(params, mc, tokens, lengths, cache, table, dtype=dt)
@@ -162,7 +167,7 @@ class Engine:
             """One fused decode+sample dispatch (one round trip, not two)."""
             logits, cache = llama.decode_step(
                 params, mc, tokens, lengths, cache, table, active, dtype=dt,
-                attn_impl=self.attn_impl,
+                attn_impl=self.attn_impl, mesh=self.mesh,
             )
             tok = sample(logits, key, temps, top_k, top_p, mask)
             return tok.astype(jnp.int32), cache
@@ -184,6 +189,7 @@ class Engine:
                 greedy=greedy,
                 dtype=dt,
                 attn_impl=self.attn_impl,
+                mesh=self.mesh,
             )
 
         self._prefill_jit = jax.jit(_prefill, donate_argnames=("cache",))
@@ -480,13 +486,26 @@ class Engine:
                 try:
                     self.alloc.extend(s.seq_id, 1)
                     grown.append(s)
+                    continue
+                except OutOfPages:
+                    pass
+                # Pool dry — possibly only transiently: the pipeline's
+                # in-flight blocks pre-book pages that their pulls roll
+                # back. Drain before declaring the sequence truncated.
+                while self._inflight:
+                    self._pull_oldest()
+                try:
+                    self.alloc.extend(s.seq_id, 1)
+                    grown.append(s)
                 except OutOfPages:
                     s.done = True
                     s.finish_reason = "length"
                     log.warning(
                         "seq %d truncated: KV page budget exhausted", s.seq_id
                     )
-            running = grown
+            # A mid-loop pipeline drain can finish earlier-grown sequences
+            # (EOS/stop in a pulled block); they must not decode further.
+            running = [s for s in grown if not s.done]
             if not running:
                 return {}
             ids: list[int | None] = [s.seq_id for s in running]
@@ -633,6 +652,16 @@ class Engine:
                     # this extend succeed after all.
                     while self._inflight:
                         _merge_pulls(out, self._pull_oldest())
+                    # The drain may have finished sequences whose lanes were
+                    # already budgeted earlier in this loop — zero them so
+                    # the dispatch does not resurrect dead rows.
+                    for lx, sx in enumerate(lane_seqs):
+                        if sx is not None and (
+                            sx not in self.sequences or self.sequences[sx].done
+                        ):
+                            alive[lx] = False
+                            budgets[lx] = 0
+                            lane_seqs[lx] = None
                     if s.done:
                         continue  # drained blocks finished it (EOS/stop)
                     got = self.alloc.extend_upto(sid, want)

@@ -136,3 +136,61 @@ def test_decode_step_with_pallas_impl_matches_xla():
     np.testing.assert_allclose(
         np.asarray(out_xla), np.asarray(out_pl), rtol=1e-4, atol=1e-4
     )
+
+
+def test_pallas_under_tp_matches_oracle():
+    """VERDICT item: the kernel must run under tensor parallelism. shard_map
+    over a tp=2 mesh (q heads + kv heads both tp-sharded) must reproduce the
+    unsharded XLA oracle — per-shard GQA needs no collective."""
+    from opsagent_tpu.ops.attention import paged_decode_attention_pallas_tp
+    from opsagent_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tp=2, dp=1, sp=1, devices=jax.devices()[:2])
+    rng = np.random.default_rng(3)
+    # K=2 kv heads (1 per shard), H=4 query heads (2 per shard), G=2.
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B=2, H=4, K=2, D=64, P=8, MaxP=4, num_pages=10,
+        lengths=[5, 17],
+    )
+    ref = paged_decode_attention(q, k_pages, v_pages, table, lens)
+    got = paged_decode_attention_pallas_tp(
+        q, k_pages, v_pages, table, lens, mesh, interpret=True
+    )
+    active = np.asarray(lens) > 0
+    np.testing.assert_allclose(
+        np.asarray(got)[active], np.asarray(ref)[active], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_pallas_under_tp_layer_form():
+    """The tp wrapper with the whole-cache [L, N, P, K, D] form + layer
+    offset must select the right layer's pages per shard."""
+    from opsagent_tpu.ops.attention import paged_decode_attention_pallas_tp
+    from opsagent_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tp=2, dp=1, sp=1, devices=jax.devices()[:2])
+    rng = np.random.default_rng(4)
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B=2, H=4, K=2, D=32, P=8, MaxP=3, num_pages=8,
+        lengths=[9, 20],
+    )
+    L = 3
+    k_l = jnp.stack([
+        jnp.asarray(rng.standard_normal(k_pages.shape), jnp.float32)
+        for _ in range(L)
+    ])
+    v_l = jnp.stack([
+        jnp.asarray(rng.standard_normal(v_pages.shape), jnp.float32)
+        for _ in range(L)
+    ])
+    for layer in (0, 2):
+        ref = paged_decode_attention(
+            q, k_l[layer], v_l[layer], table, lens
+        )
+        got = paged_decode_attention_pallas_tp(
+            q, k_l, v_l, table, lens, mesh,
+            layer=jnp.int32(layer), interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
