@@ -14,7 +14,6 @@ the Ragged-Paged-Attention design in PAPERS.md).
 
 from __future__ import annotations
 
-import functools
 import os
 
 import jax

@@ -448,6 +448,9 @@ def run_engine_server(
         tokenizer=tokenizer,
         tp=tp,
         max_batch_size=max_batch_size,
+        # Production server: compile everything before accepting requests
+        # so no client ever pays XLA compile inside its TTFT.
+        warmup=True,
     )
     engine = Engine(cfg)
     stack = ServingStack(engine)

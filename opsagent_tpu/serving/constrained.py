@@ -407,12 +407,20 @@ def json_constraint(
     cached per (schema, depth) ON the tokenizer object itself — a bounded
     LRU, so varied client schemas cannot grow memory without limit — and
     dies with its tokenizer (a global keyed on id() could go stale when
-    CPython reuses a freed object's address)."""
+    CPython reuses a freed object's address). Cache bookkeeping runs under
+    a per-tokenizer lock (API requests hit this path from many threads);
+    the slow DFA/table compile runs OUTSIDE it — two racing compiles of
+    the same schema waste one compile, never correctness."""
     import json
+    import threading
 
+    lock = tokenizer.__dict__.setdefault("_fsm_lock", threading.Lock())
     cache = tokenizer.__dict__.setdefault("_fsm_cache", {})
     key = (json.dumps(schema, sort_keys=True), depth)
-    fsm = cache.pop(key, None)
+    with lock:
+        fsm = cache.pop(key, None)
+        if fsm is not None:
+            cache[key] = fsm  # reinsert at the back = most recently used
     if fsm is None:
         dfa = compile_regex(schema_to_regex(schema, depth))
         if dfa.num_states > MAX_DFA_STATES:
@@ -423,11 +431,10 @@ def json_constraint(
             )
         tb = [tokenizer.token_bytes(t) for t in range(tokenizer.vocab_size)]
         fsm = TokenFSM(dfa, tb, tokenizer.eos_id)
-    cache[key] = fsm  # (re)insert at the back = most recently used
-    while len(cache) > FSM_CACHE_CAPACITY:
-        # Default-tolerant pop: concurrent requests (no lock on this path)
-        # may race the same LRU key; losing the race must not raise.
-        cache.pop(next(iter(cache)), None)
+        with lock:
+            cache[key] = fsm
+            while len(cache) > FSM_CACHE_CAPACITY:
+                cache.pop(next(iter(cache)), None)
     return JsonConstraint(fsm)
 
 

@@ -91,6 +91,22 @@ class PageAllocator:
             if n.refcount == 0 and n.children == 0
         )
 
+    def accounting(self) -> dict[str, int]:
+        """Page-conservation snapshot: every page is exactly one of free,
+        trie-resident (shared or cached), or exclusively owned by a live
+        sequence — so free + trie + owned == num_pages always. The
+        concurrency stress test asserts this under load (the Python answer
+        to the reference's missing `go test -race`, SURVEY section 5)."""
+        owned = sum(
+            len(s.pages) - s.num_shared for s in self._seqs.values()
+        )
+        return {
+            "free": len(self._free),
+            "trie": len(self._by_page),
+            "owned": owned,
+            "total": len(self._free) + len(self._by_page) + owned,
+        }
+
     def pages_needed(self, num_tokens: int) -> int:
         return -(-num_tokens // self.page_size)
 

@@ -61,6 +61,7 @@ class Scheduler:
         self.admission_timeout_s = admission_timeout_s
         self._queue: "queue.Queue[Request]" = queue.Queue()
         self._waiting: list[Request] = []
+        self._prefilling: dict[int, Request] = {}  # begun, chunks pending
         self._running: dict[int, Request] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -110,11 +111,16 @@ class Scheduler:
                 return
 
     def _try_admit(self) -> None:
-        """Admit waiting requests while page budget and batch slots allow."""
+        """Move waiting requests into the prefilling state while page
+        budget and batch slots allow. Only the cheap page allocation
+        happens here (engine.begin_request); the device work is advanced
+        one chunk per loop tick by ``_advance_prefill`` so long prompts
+        cannot stall running decodes."""
         still: list[Request] = []
         now = time.perf_counter()
         for req in self._waiting:
-            if len(self._running) >= self.engine.cfg.max_batch_size:
+            occupied = len(self._running) + len(self._prefilling)
+            if occupied >= self.engine.cfg.max_batch_size:
                 still.append(req)
                 continue
             if now - req.enqueued_s > self.admission_timeout_s:
@@ -122,7 +128,7 @@ class Scheduler:
                 req.done.set()
                 continue
             try:
-                seq_id = self.engine.add_request(
+                seq_id = self.engine.begin_request(
                     req.prompt_ids,
                     req.sampling,
                     mask_fn=req.mask_fn,
@@ -150,11 +156,30 @@ class Scheduler:
                 req.done.set()
                 continue
             req.seq_id = seq_id
-            self._running[seq_id] = req
+            self._prefilling[seq_id] = req
             get_perf_stats().record_metric(
                 "scheduler.queue_wait", (now - req.enqueued_s) * 1e3, "ms"
             )
         self._waiting = still
+
+    def _advance_prefill(self) -> None:
+        """Run ONE prefill chunk for the oldest admitting request. One
+        chunk per tick means a 4096-token prompt interleaves ~bucket-sized
+        slices of prefill with decode blocks instead of monopolizing the
+        device for the whole admission."""
+        if not self._prefilling:
+            return
+        sid = next(iter(self._prefilling))
+        req = self._prefilling[sid]
+        try:
+            if self.engine.prefill_step(sid):
+                self._running[sid] = self._prefilling.pop(sid)
+        except Exception as e:  # noqa: BLE001 - engine cleaned up already
+            self._prefilling.pop(sid, None)
+            req.error = f"admission failed: {e}"
+            if isinstance(e, (InvalidRequest, PromptTooLong)):
+                req.error_status = 400
+            req.done.set()
 
     def _reap(self) -> None:
         finished = [
@@ -165,24 +190,46 @@ class Scheduler:
             req = self._running.pop(sid)
             req.finish_reason = self.engine.sequences[sid].finish_reason
             req.tokens = self.engine.finish(sid)
+            if req.finish_reason == "error":
+                # The engine terminated this sequence on a raising stream
+                # callback (client went away mid-stream). Only THIS request
+                # fails; the rest of the batch keeps decoding.
+                req.error = "stream callback failed"
             req.done.set()
 
     def _loop(self) -> None:
         log.info("scheduler loop started (batch=%d)", self.engine.cfg.max_batch_size)
+        consecutive_failures = 0
         while not self._stop.is_set():
             try:
                 self._drain_queue()
                 self._try_admit()
+                self._advance_prefill()
                 self._reap()
                 if not self._running:
+                    if self._prefilling:
+                        continue  # keep advancing admission chunks
                     # idle: wait for work
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
                 self.engine.step_block(sorted(self._running))
                 self._reap()
+                consecutive_failures = 0
             except Exception as e:  # noqa: BLE001 - the loop must survive
-                log.exception("scheduler step failed; failing in-flight requests")
+                # A raising stream callback surfaces here after the engine
+                # already marked its sequence done/"error" — _reap fails
+                # just that request. Only a persistently failing engine
+                # (no per-seq attribution, no progress) fails the batch.
+                log.exception("scheduler step failed")
+                try:
+                    self._reap()
+                except Exception:  # noqa: BLE001
+                    pass
+                consecutive_failures += 1
+                if consecutive_failures < 3:
+                    continue
+                log.error("engine failing persistently; failing in-flight requests")
                 for sid, req in list(self._running.items()):
                     req.error = f"engine step failed: {e}"
                     try:
@@ -191,10 +238,19 @@ class Scheduler:
                         pass
                     req.done.set()
                 self._running.clear()
+                consecutive_failures = 0
         # drain on shutdown
         for req in self._waiting:
             req.error = "scheduler stopped"
             req.done.set()
+        for sid, req in list(self._prefilling.items()):
+            try:
+                self.engine.abort_request(sid)
+            except Exception:  # noqa: BLE001
+                pass
+            req.error = "scheduler stopped"
+            req.done.set()
+        self._prefilling.clear()
         for sid, req in list(self._running.items()):
             req.tokens = self.engine.finish(sid)
             req.error = "scheduler stopped"

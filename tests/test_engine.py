@@ -293,3 +293,107 @@ def test_drain_merges_multi_block_pulls(engine):
     got = engine.finish(sid)
     assert got == want
     assert collected == want
+
+
+def test_warmup_compiles_without_disturbing_state():
+    """warmup() must leave page accounting and generation untouched: a
+    warmed engine produces exactly what an unwarmed one does, and no pages
+    leak (warmup writes through all-dropped page tables)."""
+    cfg = EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=32, max_pages_per_seq=8, max_batch_size=2,
+        prefill_buckets=(16, 32),
+    )
+    cold = Engine(cfg)
+    want = cold.generate([[257, 1, 2, 3]], SamplingParams(max_tokens=5))[0]
+
+    warm = Engine(cfg)
+    free_before = warm.alloc.free_pages
+    dt = warm.warmup()
+    assert dt > 0
+    assert warm.alloc.free_pages == free_before
+    assert warm.sequences == {}
+    got = warm.generate([[257, 1, 2, 3]], SamplingParams(max_tokens=5))[0]
+    assert got == want
+
+
+def test_compilation_cache_dir_configured(tmp_path, monkeypatch):
+    from opsagent_tpu.serving.engine import enable_compilation_cache
+
+    monkeypatch.setenv("OPSAGENT_COMPILE_CACHE", str(tmp_path / "xla"))
+    path = enable_compilation_cache()
+    assert path == str(tmp_path / "xla")
+    import os
+    assert os.path.isdir(path)
+    assert jax.config.jax_compilation_cache_dir == path
+
+
+def test_prefill_chunks_interleave_with_decode():
+    """VERDICT item 5: admitting a long prompt must not stall running
+    decodes. begin_request/prefill_step split admission into bucket-sized
+    chunks; a running stream advances between chunks."""
+    cfg = EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=64, max_pages_per_seq=16, max_batch_size=4,
+        prefill_buckets=(8, 16), decode_block=4,
+        prefix_cache=False,  # the oracle runs below would otherwise donate
+                             # the long prompt's pages and skip its chunks
+    )
+    eng = Engine(cfg)
+    # Oracle outputs via isolated synchronous runs.
+    short = [257, 5, 6, 7]
+    long_prompt = [257] + list(range(1, 40))   # 40 tokens = 3 chunks of <=16
+    want_short = eng.generate([short], SamplingParams(max_tokens=12))[0]
+    want_long = eng.generate([long_prompt], SamplingParams(max_tokens=4))[0]
+
+    a = eng.add_request(short, SamplingParams(max_tokens=12))
+    b = eng.begin_request(long_prompt, SamplingParams(max_tokens=4))
+    assert not eng.sequences[b].tokens  # prefilling, not decodable yet
+
+    chunks = 0
+    decoded_between = 0
+    while True:
+        finished = eng.prefill_step(b)
+        chunks += 1
+        if finished:
+            break
+        if not eng.sequences[a].done:
+            out = eng.step_block([a])
+            decoded_between += sum(len(v) for v in out.values())
+    assert chunks == 3               # 16 + 16 + 8
+    eng.drain()
+    # The running stream made progress while the long prompt admitted.
+    assert decoded_between + len(eng.sequences[a].tokens) > 1
+    while not (eng.sequences[a].done and eng.sequences[b].done):
+        eng.step_block([a, b])
+    assert eng.finish(a) == want_short
+    assert eng.finish(b) == want_long
+
+
+def test_scheduler_long_admission_keeps_decodes_flowing():
+    """Scheduler-level: a long prompt admitting one chunk per tick must not
+    block a concurrently running stream; both complete correctly."""
+    from opsagent_tpu.serving.scheduler import Request, Scheduler
+
+    cfg = EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=96, max_pages_per_seq=24, max_batch_size=4,
+        prefill_buckets=(8, 16), decode_block=4,
+    )
+    eng = Engine(cfg)
+    short = [257, 9, 8, 7]
+    long_prompt = [257] + list(range(1, 60))   # 60 tokens = 4 chunks
+    want_short = eng.generate([short], SamplingParams(max_tokens=16))[0]
+    want_long = eng.generate([long_prompt], SamplingParams(max_tokens=4))[0]
+
+    sched = Scheduler(eng)
+    sched.start()
+    try:
+        r1 = sched.submit(Request(short, SamplingParams(max_tokens=16)))
+        r2 = sched.submit(Request(long_prompt, SamplingParams(max_tokens=4)))
+        assert r1.done.wait(120) and r2.done.wait(120)
+        assert not r1.error and not r2.error
+        assert r1.tokens == want_short
+        assert r2.tokens == want_long
+    finally:
+        sched.stop()

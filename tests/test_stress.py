@@ -1,0 +1,136 @@
+"""Concurrency stress: hammer the serving stack from several threads with
+mixed admissions, sampled/constrained/raising-stream requests, and chunked
+long prompts, asserting the page-conservation invariant throughout — the
+Python answer to the reference's missing `go test -race` (SURVEY §5; the
+reference CI runs plain `go test`, .github/workflows/test.yaml:23)."""
+
+import random
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.sampler import SamplingParams
+from opsagent_tpu.serving.scheduler import Request, Scheduler
+
+NUM_PAGES = 96
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=NUM_PAGES, max_pages_per_seq=24, max_batch_size=4,
+        prefill_buckets=(8, 16), decode_block=4,
+    ))
+
+
+def assert_conservation(engine):
+    acc = engine.alloc.accounting()
+    assert acc["total"] == NUM_PAGES, acc
+
+
+def test_concurrent_mixed_load_conserves_pages(engine):
+    sched = Scheduler(engine)
+    sched.start()
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def mask_fn_all(generated):
+        return np.ones((engine.model_cfg.vocab_size,), bool)
+
+    def client(tid: int):
+        rng = random.Random(tid)
+        for i in range(6):
+            n = rng.randint(3, 40)
+            prompt = [257] + [rng.randint(1, 500) for _ in range(n - 1)]
+            kind = (tid + i) % 4
+            on_token = None
+            mask_fn = None
+            sampling = SamplingParams(max_tokens=rng.randint(2, 10))
+            if kind == 1:
+                sampling = SamplingParams(
+                    max_tokens=6, temperature=0.9, top_k=8
+                )
+            elif kind == 2:
+                mask_fn = mask_fn_all
+            elif kind == 3:
+                calls = []
+
+                def boom(tok, calls=calls):  # "client went away"
+                    calls.append(tok)
+                    if len(calls) >= 2:
+                        raise RuntimeError("gone")
+
+                on_token = boom
+            req = Request(prompt, sampling, mask_fn=mask_fn, on_token=on_token)
+            sched.submit(req)
+            if not req.done.wait(120):
+                with lock:
+                    errors.append(f"t{tid} r{i}: timed out")
+                return
+            if kind == 3:
+                # Raising streams must fail ONLY their own request.
+                if not req.error:
+                    with lock:
+                        errors.append(f"t{tid} r{i}: raising stream not failed")
+            elif req.error:
+                with lock:
+                    errors.append(f"t{tid} r{i}: {req.error}")
+            elif not req.tokens:
+                with lock:
+                    errors.append(f"t{tid} r{i}: no tokens")
+            # Invariant under load (snapshot under the engine lock).
+            with engine.lock:
+                acc = engine.alloc.accounting()
+            if acc["total"] != NUM_PAGES:
+                with lock:
+                    errors.append(f"t{tid} r{i}: page leak {acc}")
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "stress client hung"
+    finally:
+        sched.stop()
+    assert errors == []
+    # Quiesced: nothing running, nothing leaked, everything conserved.
+    assert engine.sequences == {}
+    assert_conservation(engine)
+    assert engine.alloc.accounting()["owned"] == 0
+
+
+def test_admissions_race_allocation_against_decode(engine):
+    """Direct engine API from racing threads: begin/prefill/step/finish
+    interleavings must never break conservation."""
+    results: list[list[int]] = []
+    errs: list[BaseException] = []
+
+    def worker(seed: int):
+        rng = random.Random(seed)
+        try:
+            for _ in range(4):
+                n = rng.randint(3, 30)
+                prompt = [257] + [rng.randint(1, 500) for _ in range(n - 1)]
+                out = engine.generate(
+                    [prompt], SamplingParams(max_tokens=rng.randint(2, 8))
+                )
+                results.append(out[0])
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive()
+    assert errs == []
+    assert len(results) == 12 and all(len(r) >= 1 for r in results)
+    assert engine.sequences == {}
+    assert_conservation(engine)
