@@ -27,6 +27,15 @@ class MoEConfig:
     # norm_topk_prob=true with routed_scaling_factor=2.5.
     norm_topk_prob: bool = False
     routed_scaling_factor: float = 1.0
+    # Grouped-dispatch policy. Below the token threshold (decode steps,
+    # tiny batches) the all-experts scan runs instead: with T*k >= E every
+    # expert's weights stream from HBM once either way, so the scan is
+    # bandwidth-optimal and has no drop risk. Above it (prefill/training)
+    # tokens are dispatched into per-expert capacity buckets of
+    # ceil(T*k/E * capacity_factor) slots — expert FLOPs scale with top-k,
+    # not num_experts. 0 disables grouped dispatch entirely.
+    grouped_dispatch_min_tokens: int = 512
+    capacity_factor: float = 2.0
 
 
 @dataclass(frozen=True)

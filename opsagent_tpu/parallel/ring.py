@@ -37,9 +37,10 @@ NEG_INF = -1e30
 
 
 def _local_ring_attention(
-    q: jax.Array,     # [B, S_l, H, D] local shard
-    k: jax.Array,     # [B, S_l, K, D]
-    v: jax.Array,     # [B, S_l, K, D]
+    q: jax.Array,        # [B, S_l, H, D] local shard
+    k: jax.Array,        # [B, S_l, K, D]
+    v: jax.Array,        # [B, S_l, K, D]
+    lengths: jax.Array,  # [B] valid GLOBAL lengths (right padding beyond)
     axis: str,
 ) -> jax.Array:
     sp = jax.lax.axis_size(axis)
@@ -64,6 +65,12 @@ def _local_ring_attention(
             preferred_element_type=jnp.float32,
         )                                                   # [B,K,G,S_l,T]
         mask = (k_pos[None, :] <= q_pos[:, None])[None, None, None, :, :]
+        # Ragged batches: positions past a sequence's length are padding —
+        # mask them out of every ring step by GLOBAL key position, which is
+        # what lets the serving prefill path shard right-padded bucketed
+        # prompts over sp.
+        valid = (k_pos[None, :] < lengths[:, None])[:, None, None, None, :]
+        mask = jnp.logical_and(mask, valid)
         scores = jnp.where(mask, scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
@@ -102,31 +109,33 @@ def _local_ring_attention(
 def make_ring_attention(
     mesh: Mesh, axis: str = "sp"
 ) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
-    """Build a drop-in replacement for ``causal_prefill_attention`` (the
-    lengths-free training/oracle form) that runs ring attention over
-    ``axis``. Heads stay tensor-parallel over "tp"; batch over "dp"."""
+    """Build a drop-in replacement for ``causal_prefill_attention`` that
+    runs ring attention over ``axis``, for both the lengths-free training/
+    oracle form and RAGGED right-padded batches (serving prefill: each
+    sequence masks by its own global length inside every ring step).
+    Heads stay tensor-parallel over "tp"; batch over "dp"."""
     spec = P("dp", "sp", "tp", None)
+    len_spec = P("dp")  # lengths replicated over sp/tp, batch over dp
     local = functools.partial(_local_ring_attention, axis=axis)
     try:
         from jax import shard_map
 
         mapped = shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+            local, mesh=mesh,
+            in_specs=(spec, spec, spec, len_spec), out_specs=spec,
         )
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
         mapped = shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            local, mesh=mesh,
+            in_specs=(spec, spec, spec, len_spec), out_specs=spec,
             check_rep=False,
         )
 
     def ring_attn(q, k, v, lengths=None):
-        if lengths is not None:
-            raise NotImplementedError(
-                "ring attention serves the training/oracle path; ragged "
-                "lengths stay on the paged serving path"
-            )
-        return mapped(q, k, v)
+        if lengths is None:
+            lengths = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
+        return mapped(q, k, v, jnp.asarray(lengths, jnp.int32))
 
     return ring_attn

@@ -83,6 +83,15 @@ class EngineConfig:
     dtype: Any = jnp.bfloat16
     tp: int = 0                      # 0 = all devices
     dp: int = 1
+    # Sequence/context parallelism for PREFILL: with sp > 1 each prefill
+    # chunk's attention runs as ragged ring attention sharded over the sp
+    # mesh axis (parallel.ring), spreading the chunk's O(S^2) attention
+    # over sp devices — covering prompts up to the largest bucket in one
+    # sharded pass (BASELINE config 4 scale). Prefix-tail chunks (prompts
+    # beyond the largest bucket, or prefix-cache hits) attend over paged
+    # cache and stay on the pjit-partitioned gather path; decode has no
+    # sequence axis to shard.
+    sp: int = 1
     page_size: int = 16
     num_pages: int = 2048
     max_pages_per_seq: int = 320   # 5120 tokens: largest bucket + generation
@@ -138,17 +147,31 @@ class Engine:
         self.cfg = cfg
         enable_compilation_cache()
         self.model_cfg = model_cfg or get_config_preset(cfg.model)
+        if self.model_cfg.moe is not None:
+            # Serving pins the EXACT all-experts dispatch: the grouped
+            # capacity path can drop assignments under skewed routing and
+            # its activation depends on chunk token count, which varies
+            # with prefix-cache residency — a request's output must not
+            # depend on what happens to be cached. Training keeps grouped
+            # dispatch (models.llama._moe_mlp).
+            from dataclasses import replace
+
+            self.model_cfg = replace(
+                self.model_cfg,
+                moe=replace(self.model_cfg.moe, grouped_dispatch_min_tokens=0),
+            )
         self.tokenizer = tokenizer or load_tokenizer(
             cfg.tokenizer, vocab_size=self.model_cfg.vocab_size
         )
         n_dev = len(jax.devices())
+        slots = cfg.dp * cfg.sp
         tp = cfg.tp if cfg.tp > 0 else max(
-            1, n_dev // cfg.dp if n_dev % cfg.dp == 0 else 1
+            1, n_dev // slots if n_dev % slots == 0 else 1
         )
         # kv heads must divide cleanly over tp; fall back gracefully.
         while tp > 1 and self.model_cfg.num_kv_heads % tp != 0:
             tp -= 1
-        self.mesh = make_mesh(tp=tp, dp=cfg.dp)
+        self.mesh = make_mesh(tp=tp, dp=cfg.dp, sp=cfg.sp)
         self.lock = threading.RLock()
 
         key = jax.random.PRNGKey(cfg.seed)
@@ -186,8 +209,21 @@ class Engine:
             else "",
         )
 
+        # sp > 1: shard long-context prefill attention over the sp axis as
+        # a ragged ring (each sequence masks by its own length inside every
+        # ring step). Decode and the prefix-chunk path stay on paged ops.
+        if cfg.sp > 1:
+            from ..parallel.ring import make_ring_attention
+
+            prefill_attn = make_ring_attention(self.mesh)
+        else:
+            prefill_attn = None
+
         def _prefill(params, tokens, lengths, cache, table):
-            return llama.prefill(params, mc, tokens, lengths, cache, table, dtype=dt)
+            return llama.prefill(
+                params, mc, tokens, lengths, cache, table, dtype=dt,
+                prefill_attn=prefill_attn,
+            )
 
         def _prefill_prefix(params, tokens, start, lengths, cache, table):
             return llama.prefill_with_prefix(
@@ -269,6 +305,10 @@ class Engine:
         B = self.cfg.max_batch_size
         MaxP = self.cfg.max_pages_per_seq
         with self.lock, self.mesh:
+            # Re-warming a LIVE engine: settle in-flight decode state first,
+            # exactly like the legacy step path (warmup's throwaway carries
+            # would otherwise desync lanes still referenced by pulls).
+            self._flush_and_invalidate()
             drop1 = jnp.full((1, MaxP), -1, jnp.int32)
             logits = None
             for bucket in self.cfg.prefill_buckets:
@@ -693,6 +733,9 @@ class Engine:
                     s.done = True
                     s.finish_reason = s.finish_reason or "error"
                     self.alloc.truncate(s.seq_id, self._host_written(s))
+                # _accept_token appends before the callback runs, so even
+                # an errored sequence's token is in seq.tokens (and in what
+                # finish() returns) — report it, matching _pull_oldest.
                 out[s.seq_id] = tok
             get_perf_stats().record_metric("engine.decode_tokens", len(running), "tok")
             if first_exc is not None:

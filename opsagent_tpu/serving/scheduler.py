@@ -222,10 +222,16 @@ class Scheduler:
                 # just that request. Only a persistently failing engine
                 # (no per-seq attribution, no progress) fails the batch.
                 log.exception("scheduler step failed")
+                before = len(self._running)
                 try:
                     self._reap()
                 except Exception:  # noqa: BLE001
                     pass
+                if len(self._running) < before:
+                    # Attributed: the offending request(s) were reaped —
+                    # that IS progress, not an engine failure.
+                    consecutive_failures = 0
+                    continue
                 consecutive_failures += 1
                 if consecutive_failures < 3:
                     continue

@@ -79,3 +79,48 @@ def test_train_step_with_ring_matches_dense():
         metrics[ring] = (float(m["loss"]), float(m["grad_norm"]))
     np.testing.assert_allclose(metrics[True][0], metrics[False][0], rtol=1e-5)
     np.testing.assert_allclose(metrics[True][1], metrics[False][1], rtol=1e-4)
+
+
+def test_ring_ragged_lengths_match_dense():
+    """VERDICT item 8: ragged right-padded batches on an sp mesh must match
+    the dense oracle masked by per-sequence lengths (serving prefill)."""
+    mesh = make_mesh(tp=1, dp=1, sp=4, devices=jax.devices()[:4])
+    rng = np.random.default_rng(3)
+    B, S, H, K, D = 3, 32, 4, 2, 16
+    lengths = jnp.asarray([32, 17, 5], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+
+    ref = causal_prefill_attention(q, k, v, lengths=lengths)
+    ring = make_ring_attention(mesh)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring(q, k, v, lengths=lengths))(q, k, v)
+    # Compare only valid query positions; padded tails differ (ring defines
+    # fully-masked rows as zeros, the dense ref as softmax over -inf).
+    for b, n in enumerate([32, 17, 5]):
+        np.testing.assert_allclose(
+            np.asarray(got)[b, :n], np.asarray(ref)[b, :n],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_engine_prefill_with_sp_ring_matches_sp1():
+    """Engine-level: serving prefill sharded over sp=2 (ring attention)
+    must produce exactly the sp=1 engine's generations."""
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    kwargs = dict(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=64, max_pages_per_seq=16, max_batch_size=2,
+        prefill_buckets=(16, 32), prefix_cache=False,
+    )
+    prompts = [[257, 5, 6, 7, 8, 9, 10], [257, 40, 41]]
+    e1 = Engine(EngineConfig(**kwargs))
+    want = e1.generate(prompts, SamplingParams(max_tokens=6))
+
+    e2 = Engine(EngineConfig(sp=2, **kwargs))
+    assert e2.mesh.shape["sp"] == 2
+    got = e2.generate(prompts, SamplingParams(max_tokens=6))
+    assert got == want
