@@ -24,6 +24,7 @@ LLM (reference pkg/llms/openai.go:69-103); there is no counterpart Go code.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable
 
 import jax
@@ -269,8 +270,7 @@ def _moe_mlp(
     serving paths discard it)."""
     m = cfg.moe
     E, k = m.num_experts, m.num_experts_per_token
-    B, S, d = h.shape
-    T = B * S
+    T = h.shape[0] * h.shape[1]
     router_logits = (h.astype(jnp.float32) @ lp["router"])          # [B,S,E]
     probs = jax.nn.softmax(router_logits, axis=-1)
     vals, idx = jax.lax.top_k(probs, k)                             # [B,S,k]
@@ -331,8 +331,6 @@ def _moe_grouped_dispatch(
     E, k = m.num_experts, m.num_experts_per_token
     B, S, d = h.shape
     T = B * S
-    import math
-
     C = max(1, min(T, math.ceil(T * k / E * m.capacity_factor)))
     x = h.reshape(T, d)
     flat_e = idx.reshape(T * k)                       # token-major order

@@ -108,7 +108,7 @@ def _local_ring_attention(
 
 def make_ring_attention(
     mesh: Mesh, axis: str = "sp"
-) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+) -> Callable[..., jax.Array]:
     """Build a drop-in replacement for ``causal_prefill_attention`` that
     runs ring attention over ``axis``, for both the lengths-free training/
     oracle form and RAGGED right-padded batches (serving prefill: each

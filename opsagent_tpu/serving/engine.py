@@ -165,6 +165,20 @@ class Engine:
         )
         n_dev = len(jax.devices())
         slots = cfg.dp * cfg.sp
+        if cfg.sp > 1:
+            # Fail fast with the config knob named, instead of an opaque
+            # shard_map divisibility error at first prefill.
+            bad = [b for b in cfg.prefill_buckets if b % cfg.sp]
+            if bad:
+                raise ValueError(
+                    f"sp={cfg.sp} must divide every prefill bucket "
+                    f"(offending: {bad})"
+                )
+            if slots * max(1, cfg.tp) > n_dev:
+                raise ValueError(
+                    f"dp={cfg.dp} * sp={cfg.sp} * tp={max(1, cfg.tp)} "
+                    f"exceeds {n_dev} devices"
+                )
         tp = cfg.tp if cfg.tp > 0 else max(
             1, n_dev // slots if n_dev % slots == 0 else 1
         )

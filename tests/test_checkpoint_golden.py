@@ -1,0 +1,80 @@
+"""Real-checkpoint end-to-end proof (VERDICT round-1 item 6): committed
+fixtures pin loader -> model -> engine generate against golden outputs.
+
+- tiny-llama-hf / tiny-qwen2-hf were written by the GENUINE HuggingFace
+  implementations (transformers on CPU torch) along with their own forward
+  logits and greedy continuations — an independent oracle: any drift in
+  HF-name mapping, weight transposes, RoPE convention, norm epsilon, or
+  bias handling makes these fail.
+- tiny-deepseek-moe pins the DeepSeek MoE naming scheme (mlp.gate /
+  mlp.experts.N / mlp.shared_experts) as a regression fixture (transformers
+  has no in-tree DeepSeek-MoE to serve as an oracle).
+
+Regenerate with ``python tests/fixtures/make_golden.py``.
+"""
+
+import os
+from dataclasses import replace
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from opsagent_tpu.models import llama
+from opsagent_tpu.models.config import get_config_preset
+from opsagent_tpu.models.loader import load_checkpoint
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.sampler import SamplingParams
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+TINY = get_config_preset("tiny-test")
+CASES = {
+    "tiny-llama-hf": TINY,  # fixture mirrors the tiny-test architecture
+    "tiny-qwen2-hf": replace(TINY, attn_bias=True, rms_norm_eps=1e-6),
+    "tiny-deepseek-moe": get_config_preset("tiny-moe"),
+}
+
+
+def _fixture(name):
+    path = os.path.join(FIXTURES, name)
+    if not os.path.isdir(path):
+        pytest.skip(f"fixture {name} not generated")
+    golden = np.load(os.path.join(path, "golden.npz"))
+    return path, golden
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_loader_forward_matches_golden_logits(name):
+    path, golden = _fixture(name)
+    cfg = CASES[name]
+    params = load_checkpoint(path, cfg, dtype=jnp.float32)
+    prompt = golden["prompt"].tolist()
+    logits = llama.forward_full(
+        params, cfg, jnp.asarray([prompt], jnp.int32), dtype=jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0, -1]), golden["last_logits"],
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_engine_generate_matches_golden_greedy(name):
+    """End to end through the serving stack: checkpoint dir -> loader ->
+    prefill -> paged block decode must reproduce the golden greedy
+    continuation token for token."""
+    path, golden = _fixture(name)
+    cfg = CASES[name]
+    eng = Engine(
+        EngineConfig(
+            model="unused", checkpoint=path, dtype=jnp.float32, tp=1,
+            page_size=4, num_pages=64, max_pages_per_seq=16,
+            max_batch_size=2, prefill_buckets=(16, 32),
+        ),
+        model_cfg=cfg,
+    )
+    prompt = golden["prompt"].tolist()
+    want = golden["greedy"].tolist()
+    got = eng.generate([prompt], SamplingParams(max_tokens=len(want)))[0]
+    assert got == want

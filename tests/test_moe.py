@@ -235,25 +235,59 @@ class TestGroupedDispatch:
         np.testing.assert_allclose(float(aux_w), float(aux_g), rtol=1e-6)
 
     def test_grouped_flops_scale_with_capacity(self):
-        """The compiled grouped path must not contain an [E, T, f]-sized
-        expert compute: its dispatch buffer is [E, C, d] with
-        C = ceil(T*k/E * cf) << T."""
-        import math
+        """Regression guard on the actual compiled program: XLA cost
+        analysis of the grouped path must report far fewer FLOPs than the
+        all-experts scan on the same shapes (the VERDICT item's point —
+        expert compute scales with top-k*capacity, not num_experts)."""
+        # DeepSeek-shaped expert count (E >> k) — at tiny-moe's E=4, k=2
+        # the dispatch bookkeeping outweighs the expert saving; the FLOPs
+        # win this guards is the many-experts regime (config 3 is k=6 of
+        # E=64).
         from dataclasses import replace
 
-        cfg = self._cfg(grouped_dispatch_min_tokens=1, capacity_factor=1.25)
-        m = cfg.moe
-        T = 8 * 16
-        C = max(1, min(T, math.ceil(
-            T * m.num_experts_per_token / m.num_experts * m.capacity_factor
-        )))
-        assert C < T  # the whole point: per-expert slots shrink with k/E
-        params = llama.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        from opsagent_tpu.models.config import MoEConfig
+
+        def cfg_with(**flags):
+            return replace(CFG, moe=MoEConfig(
+                num_experts=16, num_experts_per_token=2,
+                num_shared_experts=1, expert_intermediate_size=64, **flags,
+            ))
+
+        params = llama.init_params(
+            cfg_with(), jax.random.PRNGKey(0), jnp.float32
+        )
         lp = jax.tree.map(lambda a: a[0], params["moe_layers"])
         h = jax.random.normal(
-            jax.random.PRNGKey(8), (8, 16, cfg.hidden_size), jnp.float32
+            jax.random.PRNGKey(8), (8, 16, CFG.hidden_size), jnp.float32
         )
-        out, _ = llama._moe_mlp(h, lp, cfg)
+
+        def flops_of(cfg):
+            fn = jax.jit(lambda h, lp: llama._moe_mlp(h, lp, cfg)[0])
+            cost = fn.lower(h, lp).compile().cost_analysis()
+            if isinstance(cost, list):  # older jax returns one per device
+                cost = cost[0]
+            return float(cost["flops"])
+
+        grouped = cfg_with(
+            grouped_dispatch_min_tokens=1, capacity_factor=1.25
+        )
+        grouped_flops = flops_of(grouped)
+        # The scan path is useless as a cost baseline (XLA cost analysis
+        # counts a while-loop body once, not per trip), so compare against
+        # the ANALYTIC all-experts expert compute: E * T * 3 matmuls of
+        # [d, fe]. Grouped runs E * C slots with C = ceil(T*k/E * cf), or
+        # ~0.16x here — assert well under the dense count, which fails if
+        # the path regresses to computing every expert on every token.
+        m = grouped.moe
+        T = 8 * 16
+        dense_expert_flops = (
+            m.num_experts * T * 3 * 2 * CFG.hidden_size
+            * m.expert_intermediate_size
+        )
+        assert grouped_flops < 0.5 * dense_expert_flops, (
+            grouped_flops, dense_expert_flops
+        )
+        out, _ = llama._moe_mlp(h, lp, grouped)
         assert out.shape == h.shape
         assert not np.isnan(np.asarray(out)).any()
 
