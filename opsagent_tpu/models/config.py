@@ -132,6 +132,23 @@ BENCH_3B = _register(
     )
 )
 
+# 8B-class bench model: exactly the Llama-3-8B architecture (the BASELINE
+# north-star class). In bf16 its 16 GB of weights do NOT fit one 16 GB v5e
+# chip — bench.py serves it with weight-only int8 (models.quant), 8 GB.
+BENCH_8B = _register(
+    ModelConfig(
+        name="bench-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=500000.0,
+        max_position=8192,
+    )
+)
+
 # -- production model families (published architecture hyperparameters) -----
 LLAMA3_8B = _register(
     ModelConfig(

@@ -210,6 +210,26 @@ def cache_specs(cfg: ModelConfig) -> Params:
 
 
 # -- building blocks --------------------------------------------------------
+def _mm(x: jax.Array, w: Any) -> jax.Array:
+    """Matmul against a plain array or a weight-only-int8 QuantizedLinear
+    (models.quant): the dequantize multiplies fuse into the matmul operand
+    read under XLA, so quantized weights stream from HBM as int8."""
+    from .quant import QuantizedLinear
+
+    if isinstance(w, QuantizedLinear):
+        return x @ w.dequantize().astype(x.dtype)
+    return x @ w
+
+
+def _ein(sub: str, x: jax.Array, w: Any) -> jax.Array:
+    """einsum twin of ``_mm`` for the batched expert matmuls."""
+    from .quant import QuantizedLinear
+
+    if isinstance(w, QuantizedLinear):
+        return jnp.einsum(sub, x, w.dequantize().astype(x.dtype))
+    return jnp.einsum(sub, x, w)
+
+
 def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
@@ -221,9 +241,9 @@ def _qkv(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     B, S, _ = x.shape
     K, D = cfg.num_kv_heads, cfg.head_dim_
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = _mm(x, lp["wq"])
+    k = _mm(x, lp["wk"])
+    v = _mm(x, lp["wv"])
     if cfg.attn_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -236,7 +256,7 @@ def _qkv(
 
 
 def _mlp(x: jax.Array, lp: Params) -> jax.Array:
-    return (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
+    return _mm(jax.nn.silu(_mm(x, lp["wg"])) * _mm(x, lp["wu"]), lp["wd"])
 
 
 def _moe_mlp(
@@ -301,7 +321,7 @@ def _moe_mlp(
 
         def expert_step(acc, scanned):
             eg, eu, ed, c = scanned
-            y = (jax.nn.silu(h @ eg) * (h @ eu)) @ ed
+            y = _mm(jax.nn.silu(_mm(h, eg)) * _mm(h, eu), ed)
             return acc + c[..., None] * y, None
 
         out, _ = jax.lax.scan(
@@ -310,7 +330,9 @@ def _moe_mlp(
             (lp["eg"], lp["eu"], lp["ed"], combine),
         )
     if m.num_shared_experts:
-        out = out + (jax.nn.silu(h @ lp["sg"]) * (h @ lp["su"])) @ lp["sd"]
+        out = out + _mm(
+            jax.nn.silu(_mm(h, lp["sg"])) * _mm(h, lp["su"]), lp["sd"]
+        )
     return out, aux
 
 
@@ -346,9 +368,9 @@ def _moe_grouped_dispatch(
         x[token_of], mode="drop"
     ).reshape(E, C, d)
     up = jax.nn.silu(
-        jnp.einsum("ecd,edf->ecf", disp, lp["eg"])
-    ) * jnp.einsum("ecd,edf->ecf", disp, lp["eu"])
-    y = jnp.einsum("ecf,efd->ecd", up, lp["ed"])       # [E, C, d]
+        _ein("ecd,edf->ecf", disp, lp["eg"])
+    ) * _ein("ecd,edf->ecf", disp, lp["eu"])
+    y = _ein("ecf,efd->ecd", up, lp["ed"])             # [E, C, d]
     y = y.reshape(E * C, d)
     # Gather each assignment's routed output; dropped slots contribute 0.
     safe = jnp.where(keep, dest, 0)
@@ -390,7 +412,7 @@ def _run_stack(
             x, aux, kc, vc, li = carry
             h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             attn, kc, vc = attn_fn(h, lp, kc, vc, li)
-            x = x + attn @ lp["wo"]
+            x = x + _mm(attn, lp["wo"])
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
             if moe:
                 y, layer_aux = _moe_mlp(h, lp, cfg)
@@ -536,7 +558,7 @@ def decode_step(
 def _lm_head(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     if cfg.tie_embeddings:
         return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return _mm(x, params["lm_head"]).astype(jnp.float32)
 
 
 def forward_full(

@@ -1,0 +1,130 @@
+"""Weight-only int8 quantization for serving.
+
+The decode step is HBM-bandwidth-bound: every step streams the full weight
+set. Storing weights as int8 with per-output-channel symmetric scales
+halves that traffic (and halves the footprint — Llama-3-8B drops from
+~16 GB bf16, which does NOT fit a 16 GB v5e chip, to ~8 GB, which does).
+Compute stays on the bf16 MXU path: XLA fuses the dequantize
+(int8 -> bf16 multiply by scale) into the matmul operand read, so there is
+no separate materialized dequantized copy.
+
+Design: a ``QuantizedLinear`` pytree leaf-pair {q: int8 [..., in, out],
+scale: [..., out]} that the model's matmul helper (``llama._mm``)
+dispatches on — model code is otherwise unchanged, and the quantized tree
+shards with the same PartitionSpecs (the scale follows its weight's output
+axis). Per-channel symmetric scaling keeps greedy decoding faithful
+(weight-only int8 is the standard near-lossless serving configuration; no
+activation quantization).
+
+The reference has no counterpart — its "model" is a remote HTTPS API
+(reference pkg/llms/openai.go:69); quantization is part of the in-tree
+serving engine that replaces it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedLinear:
+    """int8 weight + per-output-channel scale; acts as a matmul rhs."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q          # int8, [..., in, out]
+        self.scale = scale  # float32, [..., 1, out]
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def dequantize(self) -> jax.Array:
+        return self.q.astype(self.scale.dtype) * self.scale
+
+
+def quantize_weight(w: jax.Array) -> QuantizedLinear:
+    """Symmetric per-output-channel int8: scale = absmax / 127 over the
+    input (contraction) axis, which is axis -2 of our [in, out] layout.
+    Scales stay float32 — they are [..., 1, out] (a few MB even at 8B),
+    and a bf16 scale would add ~0.4% multiplicative error per channel on
+    top of the int8 rounding."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return QuantizedLinear(q.astype(jnp.int8), scale.astype(jnp.float32))
+
+
+# Weights worth quantizing: the big matmuls. Norm vectors, biases, and the
+# f32 router stay exact (tiny, and routing is precision-sensitive).
+_QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "wg", "wu", "wd",
+     "eg", "eu", "ed", "sg", "su", "sd", "lm_head"}
+)
+
+
+def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
+    """Quantize every large linear in the stacked param tree (embed stays
+    in compute dtype: its gather reads one row per token, not the whole
+    table, so int8 would save little and cost a per-token dequant).
+
+    MUST run on host-resident weights for large models: the whole point
+    is that the full-precision tree does not fit the chip — the engine
+    loads/initializes under a CPU default device, quantizes there, and
+    only then device_puts the int8 tree onto the mesh."""
+
+    def walk(tree: dict[str, Any]) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for key, leaf in tree.items():
+            if isinstance(leaf, dict):
+                out[key] = walk(leaf)
+            elif key in _QUANT_KEYS:
+                out[key] = quantize_weight(leaf)
+            else:
+                out[key] = leaf
+        return out
+
+    return walk(params)
+
+
+def quantize_specs(specs: dict[str, Any]) -> dict[str, Any]:
+    """PartitionSpec tree STRUCTURALLY matching ``quantize_params``' output
+    (quantized leaves become QuantizedLinear nodes whose children are the
+    weight's spec and the scale's spec, so jax.tree.map pairs them): the
+    int8 weight keeps its spec; the scale broadcasts over the contraction
+    axis (None) and shards with the weight's output axis."""
+
+    def scale_spec(spec: P) -> P:
+        # [..., in, out] weight -> [..., 1, out] scale: same rank; only
+        # the -2 (contraction) entry must be unsharded.
+        parts = list(spec)
+        if len(parts) >= 2:
+            parts[-2] = None
+        return P(*parts)
+
+    def walk(tree: dict[str, Any]) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for key, leaf in tree.items():
+            if isinstance(leaf, dict):
+                out[key] = walk(leaf)
+            elif key in _QUANT_KEYS:
+                out[key] = QuantizedLinear(leaf, scale_spec(leaf))
+            else:
+                out[key] = leaf
+        return out
+
+    return walk(specs)

@@ -439,6 +439,7 @@ def run_engine_server(
     tokenizer: str = "",
     tp: int = 0,
     max_batch_size: int = 8,
+    quantize: str = "",
 ) -> None:
     from aiohttp import web
 
@@ -448,6 +449,7 @@ def run_engine_server(
         tokenizer=tokenizer,
         tp=tp,
         max_batch_size=max_batch_size,
+        quantize=quantize,
         # Production server: compile everything before accepting requests
         # so no client ever pays XLA compile inside its TTFT.
         warmup=True,

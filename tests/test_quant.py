@@ -1,0 +1,101 @@
+"""Weight-only int8 quantization (models.quant): numerical fidelity of the
+per-channel scheme, engine integration, and sharded execution — the path
+that fits Llama-3-8B onto one 16 GB v5e chip and halves decode's weight
+streaming."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from opsagent_tpu.models import llama
+from opsagent_tpu.models.config import get_config_preset
+from opsagent_tpu.models.quant import (
+    QuantizedLinear,
+    quantize_params,
+    quantize_specs,
+    quantize_weight,
+)
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.sampler import SamplingParams
+
+CFG = get_config_preset("tiny-test")
+
+
+def test_quantize_weight_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 128)) * 0.05, jnp.float32)
+    q = quantize_weight(w)
+    assert q.q.dtype == jnp.int8
+    err = np.abs(np.asarray(q.dequantize()) - np.asarray(w))
+    # Symmetric per-channel: max error is half a quantization step.
+    step = np.asarray(q.scale)[0]
+    assert (err <= step / 2 + 1e-7).all()
+
+
+def test_quantized_forward_close_to_fp():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qparams = quantize_params(params)
+    toks = jnp.asarray([[257, 72, 101, 108, 108, 111]], jnp.int32)
+    ref = np.asarray(llama.forward_full(params, CFG, toks, dtype=jnp.float32))
+    got = np.asarray(llama.forward_full(qparams, CFG, toks, dtype=jnp.float32))
+    # Weight-only int8 is near-lossless: logits stay highly correlated and
+    # the greedy choice at every position survives.
+    corr = np.corrcoef(ref.ravel(), got.ravel())[0, 1]
+    assert corr > 0.999
+    # Random tiny-model logits are nearly flat, so exact argmax equality
+    # everywhere is too strict; most positions must still agree.
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree >= 0.8, agree
+
+
+def test_specs_tree_matches_params_tree():
+    params = llama.init_params(
+        get_config_preset("tiny-moe"), jax.random.PRNGKey(0), jnp.float32
+    )
+    qparams = quantize_params(params)
+    qspecs = quantize_specs(
+        llama.param_specs(get_config_preset("tiny-moe"))
+    )
+    # Structures must pair exactly for shard_params' tree.map.
+    jax.tree.map(lambda a, b: None, qparams, qspecs)
+
+
+def test_engine_generate_quantized():
+    kwargs = dict(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=64, max_pages_per_seq=16, max_batch_size=2,
+        prefill_buckets=(16, 32), prefix_cache=False,
+    )
+    fp = Engine(EngineConfig(**kwargs))
+    want = fp.generate([[257, 5, 6, 7]], SamplingParams(max_tokens=6))[0]
+    q = Engine(EngineConfig(quantize="int8", **kwargs))
+    got = q.generate([[257, 5, 6, 7]], SamplingParams(max_tokens=6))[0]
+    # Tiny random models have near-tied logits, so token-exact agreement
+    # with the fp engine is not guaranteed; the quantized engine must
+    # still produce a full, well-formed generation (fidelity itself is
+    # asserted against logits in test_quantized_forward_close_to_fp).
+    assert len(got) >= 1
+    assert len(got) == len(want) or got[-1] == q.tokenizer.eos_id
+
+
+def test_engine_quantized_under_tp_mesh():
+    """Quantized params must shard and execute on a tp=2 mesh (int8 weight
+    + scale follow the weight's output-axis sharding)."""
+    eng = Engine(EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=2, page_size=4,
+        num_pages=64, max_pages_per_seq=16, max_batch_size=2,
+        prefill_buckets=(16,), quantize="int8",
+    ))
+    assert eng.mesh.shape["tp"] == 2
+    out = eng.generate([[257, 1, 2, 3]], SamplingParams(max_tokens=4))
+    assert len(out[0]) >= 1
+
+
+def test_rejects_unknown_quantize():
+    with pytest.raises(ValueError, match="only 'int8'"):
+        Engine(EngineConfig(
+            model="tiny-test", dtype=jnp.float32, quantize="fp4",
+            num_pages=16, page_size=4, max_pages_per_seq=4,
+            prefill_buckets=(16,),
+        ))
