@@ -1,20 +1,34 @@
-"""Device mesh and sharding utilities.
+"""Device mesh and sharding utilities: the distributed communication
+backend of the framework.
 
 The reference has no distributed layer at all (SURVEY.md section 2.2); this
 module is the foundation of the new framework's TPU story: a named
 ``jax.sharding.Mesh`` with axes
 
+- ``pp``  — pipeline parallel (layer stages; size 1 until stages land, but
+  the axis exists so stage sharding is an annotation change, not a mesh
+  redesign — SURVEY §2.2 "design the mesh so PP can be added"),
 - ``dp``  — data/batch parallel (concurrent agent sessions),
-- ``tp``  — tensor parallel (attention heads / MLP hidden, over ICI),
-- ``sp``  — sequence/context parallel (long-context prefill, ring attention).
+- ``sp``  — sequence/context parallel (long-context prefill, ring attention),
+- ``tp``  — tensor parallel (attention heads / MLP hidden, over ICI).
 
-All model code expresses placement as ``PartitionSpec`` trees over these axis
-names; XLA inserts the collectives (psum / all-gather / reduce-scatter) from
-the shardings — there is no hand-written NCCL-style backend to port.
+Axis ORDER encodes the network topology: outer axes map to the slower
+links. Across hosts/slices, ``init_distributed()`` then
+``make_mesh(dp=jax.process_count(), tp=per_host_devices)`` lays pp/dp on
+DCN while sp/tp stay on intra-slice ICI — the placement "How to Scale
+Your Model" prescribes: gradient/batch traffic tolerates DCN latency,
+per-layer collectives (all-reduce at the row-parallel matmuls, ppermute
+in the ring) do not.
+
+All model code expresses placement as ``PartitionSpec`` trees over these
+axis names; XLA inserts the collectives (psum / all-gather /
+reduce-scatter / ppermute) from the shardings — there is no hand-written
+NCCL-style backend, because the XLA runtime IS the collective backend.
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any
 
@@ -25,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 @dataclass(frozen=True)
 class MeshAxes:
+    pp: str = "pp"
     dp: str = "dp"
     tp: str = "tp"
     sp: str = "sp"
@@ -33,29 +48,66 @@ class MeshAxes:
 AXES = MeshAxes()
 
 
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join a multi-host JAX runtime (the DCN half of the comm backend).
+
+    Call once per host before building meshes; afterwards ``jax.devices()``
+    spans every host and ``make_mesh`` shards across them transparently.
+    Arguments default to the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) and to TPU-pod metadata when
+    launched by the TPU runtime (all-None on a pod slice). Returns the
+    process count. No-op (returns 1) when neither arguments nor env are
+    present — single-host runs need no coordinator."""
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    npxs = num_processes if num_processes is not None else (
+        int(os.environ["JAX_NUM_PROCESSES"])
+        if "JAX_NUM_PROCESSES" in os.environ else None
+    )
+    pid = process_id if process_id is not None else (
+        int(os.environ["JAX_PROCESS_ID"])
+        if "JAX_PROCESS_ID" in os.environ else None
+    )
+    if (
+        addr is None and npxs is None and pid is None
+        and not os.environ.get("TPU_WORKER_ID")
+    ):
+        return 1
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=npxs, process_id=pid
+    )
+    return jax.process_count()
+
+
 def make_mesh(
     tp: int | None = None,
     dp: int = 1,
     sp: int = 1,
+    pp: int = 1,
     devices: list[Any] | None = None,
 ) -> Mesh:
-    """Build a (dp, sp, tp) mesh. ``tp=None`` uses all remaining devices.
-
-    On a single host this is the v5e slice over ICI; across hosts
-    ``jax.distributed.initialize`` extends the same mesh over DCN with dp/pp
-    as the outer (slow) axes, which is why dp is the leading mesh dim.
-    """
+    """Build a (pp, dp, sp, tp) mesh. ``tp=None`` uses all remaining
+    devices. Axis order puts pp/dp outermost so they land on the slowest
+    links (DCN across slices) and sp/tp innermost (ICI)."""
     devs = devices if devices is not None else jax.devices()
     n = len(devs)
     if tp is None or tp <= 0:
-        if n % (dp * sp) != 0:
-            raise ValueError(f"{n} devices not divisible by dp*sp={dp * sp}")
-        tp = n // (dp * sp)
-    need = dp * sp * tp
+        if n % (pp * dp * sp) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by pp*dp*sp={pp * dp * sp}"
+            )
+        tp = n // (pp * dp * sp)
+    need = pp * dp * sp * tp
     if need > n:
-        raise ValueError(f"mesh dp={dp} sp={sp} tp={tp} needs {need} devices, have {n}")
-    grid = np.array(devs[:need]).reshape(dp, sp, tp)
-    return Mesh(grid, (AXES.dp, AXES.sp, AXES.tp))
+        raise ValueError(
+            f"mesh pp={pp} dp={dp} sp={sp} tp={tp} needs {need} devices, "
+            f"have {n}"
+        )
+    grid = np.array(devs[:need]).reshape(pp, dp, sp, tp)
+    return Mesh(grid, (AXES.pp, AXES.dp, AXES.sp, AXES.tp))
 
 
 def replicate(mesh: Mesh) -> NamedSharding:
