@@ -121,13 +121,19 @@ def main() -> None:
     # Steady-state decode: `steps` tokens per sequence, block dispatches.
     # The final drain pulls the last in-flight blocks so `produced` counts
     # exactly the tokens whose compute falls inside dt.
+    # OPSAGENT_PROFILE_DIR=<dir> captures a jax.profiler device trace of
+    # exactly the timed window (open in TensorBoard to see where the
+    # ms/step go); a no-op otherwise.
+    from opsagent_tpu.utils.profiling import trace
+
     block = eng.cfg.decode_block
     t0 = time.perf_counter()
     produced = 0
-    for _ in range(max(1, steps // block)):
-        out = eng.step_block(ids)
-        produced += sum(len(v) for v in out.values())
-    produced += sum(len(v) for v in eng.drain().values())
+    with trace():
+        for _ in range(max(1, steps // block)):
+            out = eng.step_block(ids)
+            produced += sum(len(v) for v in out.values())
+        produced += sum(len(v) for v in eng.drain().values())
     dt = time.perf_counter() - t0
 
     tok_s = produced / dt
@@ -167,7 +173,6 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
 
     from opsagent_tpu.serving.api import ServingStack
 
-    rng = np.random.default_rng(1)
     stack = ServingStack(eng)
     gen_tokens = max(16, steps // 8)
     rounds = 3
@@ -177,7 +182,10 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
     def session(sid: int) -> None:
         # Chat history grows across rounds like a real agent loop — each
         # round re-sends the whole conversation, so the prefix cache
-        # carries the earlier rounds' KV.
+        # carries the earlier rounds' KV. Per-session generator: numpy
+        # Generators are not thread-safe, and distinct seeds keep prompts
+        # distinct so cross-session prefix hits can't inflate the number.
+        rng = np.random.default_rng(1000 + sid)
         words = [f"w{rng.integers(0, 9999)}" for _ in range(prompt_len // 2)]
         messages = [
             {"role": "system", "content": "bench session"},

@@ -96,6 +96,12 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("", "tpu", "cpu"),
         help="force the JAX platform (default: environment's choice)",
     )
+    se.add_argument(
+        "--profile-dir",
+        default="",
+        help="capture jax.profiler device traces into this directory "
+             "(also enables device.* per-step timings in /api/perf/stats)",
+    )
 
     return p
 
@@ -140,6 +146,11 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.command == "serve-engine":
+        if args.profile_dir:
+            # One env var drives both the trace destination and the
+            # device.* per-step timings (utils/profiling.py reads it).
+            os.environ["OPSAGENT_PROFILE_DIR"] = args.profile_dir
+            os.environ.setdefault("OPSAGENT_DEVICE_TIMING", "1")
         if args.platform:
             # jax may already be imported (TPU-plugin sitecustomize), so the
             # config update is the only reliable override.

@@ -424,10 +424,51 @@ def build_engine_app(stack: ServingStack):
             )
         return web.json_response(out)
 
+    async def profile_start(request: web.Request) -> web.Response:
+        # On-demand jax.profiler capture around live traffic: POST with
+        # {"logdir": ...} (or rely on $OPSAGENT_PROFILE_DIR / --profile-dir)
+        # then hit /v1/profile/stop and open the dir in TensorBoard. The
+        # device-side complement to GET /api/perf/stats' host timers
+        # (reference only has the latter: pkg/api/router.go:104).
+        from ..utils.profiling import profile_dir
+
+        # The trace destination is operator-configured only (--profile-dir
+        # / $OPSAGENT_PROFILE_DIR): a network client must not get an
+        # arbitrary-filesystem-write primitive out of the serving port.
+        logdir = profile_dir()
+        if not logdir:
+            return web.json_response(
+                {"error": {"message": "profiling not enabled: start the "
+                                      "server with --profile-dir"}},
+                status=403,
+            )
+        import jax
+
+        try:
+            jax.profiler.start_trace(logdir)
+        except Exception as e:  # noqa: BLE001 - already tracing / bad dir
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=409
+            )
+        return web.json_response({"status": "tracing", "logdir": logdir})
+
+    async def profile_stop(request: web.Request) -> web.Response:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 - not tracing / write failure
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=409
+            )
+        return web.json_response({"status": "stopped"})
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", completions)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/healthz", healthz)
+    app.router.add_post("/v1/profile/start", profile_start)
+    app.router.add_post("/v1/profile/stop", profile_stop)
     return app
 
 

@@ -31,6 +31,7 @@ from ..models.config import ModelConfig, get_config_preset
 from ..parallel.mesh import make_mesh, shard_params
 from ..utils.logger import get_logger
 from ..utils.perf import get_perf_stats
+from ..utils.profiling import annotate, device_timer
 from .kvcache import InvalidRequest, PageAllocator, OutOfPages
 from .sampler import SamplingParams, sample
 from .tokenizer import Tokenizer, load_tokenizer
@@ -492,7 +493,9 @@ class Engine:
                 bucket = self._bucket(chunk)
                 tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
                 tokens[0, :chunk] = seq.prompt_ids[done:done + chunk]
-                with self.mesh:
+                dev_out: list = []
+                with annotate("engine.prefill_chunk"), \
+                        device_timer("prefill_chunk", dev_out), self.mesh:
                     if done:
                         logits, self.cache = self._prefill_prefix_jit(
                             self.params,
@@ -510,6 +513,7 @@ class Engine:
                             self.cache,
                             table,
                         )
+                    dev_out.append(logits)
                 done += chunk
                 perf = get_perf_stats()
                 perf.record_metric("engine.prefill_tokens", chunk, "tok")
@@ -949,7 +953,9 @@ class Engine:
             c_tok, c_at, c_eos, c_key = self._carry
             perf = get_perf_stats()
             t_disp = time.perf_counter()
-            with self.mesh:
+            dev_out: list = []
+            with annotate("engine.decode_block"), \
+                    device_timer("decode_block", dev_out), self.mesh:
                 toks, self.cache, self._carry = self._decode_pipeline_jit(
                     self.params,
                     c_tok, c_at, c_eos, c_key,
@@ -965,6 +971,7 @@ class Engine:
                     jnp.asarray(top_p),
                     greedy=greedy,
                 )
+                dev_out.append(toks)
             perf.record_metric(
                 "engine.block_dispatch", (time.perf_counter() - t_disp) * 1e3,
                 "ms",

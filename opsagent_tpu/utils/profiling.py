@@ -1,0 +1,108 @@
+"""Device-level profiling: ``jax.profiler`` traces and per-step device
+timings, layered on the request-level perf registry.
+
+The reference instruments the host path only — named start/stop timers
+aggregated to min/max/avg/p95/p99 (reference pkg/utils/perf.go:168-210),
+exposed at GET /api/perf/stats (reference pkg/api/router.go:104). On TPU
+that misses where the time actually goes: host wall-clock around a dispatch
+measures the *enqueue*, not the device, because XLA execution is async.
+This module adds the two device-side views SURVEY §5 calls for:
+
+1. **Traces** — ``trace()`` wraps a region in a ``jax.profiler`` capture
+   (TensorBoard/xprof format: per-op device timelines, HLO, memory). Opt-in
+   via ``OPSAGENT_PROFILE_DIR`` or an explicit ``logdir``; no-op otherwise,
+   so production serving pays nothing.
+2. **Per-step device timings** — ``device_timer()`` blocks on the step's
+   output arrays and records the *synchronous* elapsed time into the perf
+   registry under a ``device.`` prefix, so ``/api/perf/stats`` shows device
+   step time next to the host-side dispatch/pull timers. Blocking defeats
+   the engine's dispatch pipelining, so this is opt-in via
+   ``OPSAGENT_DEVICE_TIMING=1`` — a measurement mode, not a serving mode.
+
+``annotate()`` names host regions inside an active trace (shows up on the
+trace timeline), and is free when no trace is running.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Iterator
+
+import jax
+
+from .logger import get_logger
+from .perf import get_perf_stats
+
+log = get_logger("profiling")
+
+_ENV_DIR = "OPSAGENT_PROFILE_DIR"
+_ENV_TIMING = "OPSAGENT_DEVICE_TIMING"
+
+
+def profile_dir() -> str | None:
+    """The configured trace directory, or None when tracing is off."""
+    return os.environ.get(_ENV_DIR) or None
+
+
+def device_timing_enabled() -> bool:
+    return os.environ.get(_ENV_TIMING, "") not in ("", "0", "false")
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None = None) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace of the enclosed region into
+    ``logdir`` (or ``$OPSAGENT_PROFILE_DIR``). No-op when neither is set.
+
+    The capture includes device timelines for every XLA program launched
+    inside the region — the tool for answering "where do the ms/step go"
+    that host timers cannot (they only see the async enqueue).
+    """
+    logdir = logdir or profile_dir()
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    log.info(f"jax.profiler trace started -> {logdir}")
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info(f"jax.profiler trace written -> {logdir}")
+
+
+def annotate(name: str) -> contextlib.AbstractContextManager:
+    """Name a host region on the profiler timeline (TraceAnnotation).
+    Free when no trace is active; safe to leave in the hot path."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def device_timer(name: str, outputs: list[Any]) -> Iterator[None]:
+    """Measure the device time of one dispatched step.
+
+    Appends the step's output arrays to ``outputs`` inside the body; on
+    exit (when enabled) blocks until they are ready and records the
+    synchronous wall time as ``device.<name>`` in the perf registry. When
+    ``OPSAGENT_DEVICE_TIMING`` is unset this is a plain pass-through — no
+    sync, no pipeline stall.
+    """
+    if not device_timing_enabled():
+        yield
+        return
+    import time
+
+    t0 = time.perf_counter()
+    yield
+    for out in outputs:
+        jax.block_until_ready(out)
+    get_perf_stats().record_metric(
+        f"device.{name}", (time.perf_counter() - t0) * 1e3, "ms"
+    )
+
+
+def save_device_memory_profile(path: str) -> None:
+    """Dump the current device memory profile (pprof format) — which
+    buffers hold HBM right now. Pairs with the allocator's page
+    accounting for leak hunts."""
+    jax.profiler.save_device_memory_profile(path)

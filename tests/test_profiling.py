@@ -1,0 +1,53 @@
+"""Device-level profiling (utils/profiling.py): jax.profiler traces and
+per-step device timings — SURVEY §5's TPU additions over the reference's
+host-only timer registry (reference pkg/utils/perf.go:168-210)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from opsagent_tpu.utils import profiling
+from opsagent_tpu.utils.perf import get_perf_stats
+
+
+def test_trace_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("OPSAGENT_PROFILE_DIR", raising=False)
+    with profiling.trace():  # must not start a real trace
+        jnp.ones((4,)).block_until_ready()
+
+
+def test_trace_writes_capture(tmp_path, monkeypatch):
+    logdir = tmp_path / "prof"
+    with profiling.trace(str(logdir)):
+        jax.jit(lambda x: x * 2)(jnp.ones((8, 8))).block_until_ready()
+    files = [
+        os.path.join(r, f) for r, _, fs in os.walk(logdir) for f in fs
+    ]
+    assert files, "jax.profiler trace produced no capture files"
+
+
+def test_annotate_is_free_outside_trace():
+    with profiling.annotate("unit-test-region"):
+        pass
+
+
+def test_device_timer_records_metric(monkeypatch):
+    monkeypatch.setenv("OPSAGENT_DEVICE_TIMING", "1")
+    perf = get_perf_stats()
+    perf.reset()
+    outs: list = []
+    with profiling.device_timer("unit_step", outs):
+        outs.append(jax.jit(lambda x: x + 1)(jnp.zeros((16,))))
+    stats = perf.get_stats()
+    assert "device.unit_step" in stats
+    assert stats["device.unit_step"]["count"] == 1
+
+
+def test_device_timer_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("OPSAGENT_DEVICE_TIMING", raising=False)
+    perf = get_perf_stats()
+    perf.reset()
+    with profiling.device_timer("disabled_step", []):
+        pass
+    assert "device.disabled_step" not in perf.get_stats()

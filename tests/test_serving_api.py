@@ -4,6 +4,7 @@ slice with zero external API calls."""
 
 import asyncio
 import json
+import os
 import threading
 
 import jax.numpy as jnp
@@ -451,3 +452,47 @@ def test_multibyte_stop_string_halts_engine_side(stack):
     seq = Sequence(seq_id=0, prompt_len=1, params=SamplingParams(stop=("終了" * 5,)))
     seq.tokens = list(("x" + "終了" * 5).encode("utf-8"))
     assert stack.engine._hit_stop_string(seq)
+
+
+def test_profile_endpoints(stack, tmp_path, monkeypatch):
+    """/v1/profile/{start,stop}: operator-gated jax.profiler capture around
+    live traffic. Without --profile-dir the start endpoint refuses (403) —
+    a network client must not get a filesystem-write primitive; with it, a
+    start/traffic/stop cycle writes a capture and double-stop is a 409."""
+    app = build_engine_app(stack)
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            monkeypatch.delenv("OPSAGENT_PROFILE_DIR", raising=False)
+            r = await client.post("/v1/profile/start", json={"logdir": "/etc"})
+            assert r.status == 403  # client-supplied logdir is never honored
+
+            logdir = str(tmp_path / "trace")
+            monkeypatch.setenv("OPSAGENT_PROFILE_DIR", logdir)
+            r = await client.post("/v1/profile/start")
+            assert r.status == 200
+            assert (await r.json())["logdir"] == logdir
+
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "max_tokens": 2},
+            )
+            assert r.status == 200
+
+            r = await client.post("/v1/profile/stop")
+            assert r.status == 200
+            files = [
+                os.path.join(root, f)
+                for root, _, fs in os.walk(logdir) for f in fs
+            ]
+            assert files, "trace capture wrote no files"
+
+            r = await client.post("/v1/profile/stop")
+            assert r.status == 409  # not tracing
+        finally:
+            await client.close()
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(scenario())
