@@ -8,8 +8,12 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-Model/batch are overridable via env (OPSAGENT_BENCH_MODEL,
-OPSAGENT_BENCH_BATCH, OPSAGENT_BENCH_STEPS). On a CPU-only host the bench
+On a TPU host, a plain `python bench.py` runs BOTH presets in isolated
+subprocesses — bench-1b first (guaranteed number), then the bench-8b
+headline (int8, the BASELINE 8B-class target) — and prints the 8B result
+with the 1B throughput alongside in `extra`. Model/batch are overridable
+via env (OPSAGENT_BENCH_MODEL, OPSAGENT_BENCH_BATCH, OPSAGENT_BENCH_STEPS),
+which runs that single configuration inline. On a CPU-only host the bench
 automatically drops to the tiny test model so it still completes; the
 recorded number is only meaningful on TPU.
 
@@ -39,6 +43,86 @@ def log(msg: str) -> None:
 
 
 def main() -> None:
+    # Plain `python bench.py` on a TPU host orchestrates BOTH presets in
+    # subprocesses (1B first for a guaranteed number, then the 8B-class
+    # headline). Explicit OPSAGENT_BENCH_MODEL/MODE requests run inline.
+    if (
+        os.environ.get("OPSAGENT_BENCH_MODEL")
+        or os.environ.get("OPSAGENT_BENCH_MODE")
+    ):
+        run_single()
+    elif _probe_platform() == "tpu":
+        run_orchestrated()
+    else:
+        run_single()
+
+
+def _probe_platform() -> str:
+    """Platform of jax.devices()[0], probed in a SUBPROCESS so the parent
+    never initializes the TPU client itself — on single-chip tunneled
+    setups the parent holding the device would starve the child runs."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=300,
+        )
+        return out.stdout.strip().splitlines()[-1] if out.stdout else "none"
+    except Exception:  # noqa: BLE001
+        return "none"
+
+
+def _run_child(model: str, timeout_s: int) -> dict | None:
+    """Run one bench preset in a subprocess; return its parsed JSON line.
+    Subprocess isolation means a wedged device link or OOM in one preset
+    cannot take down the other's already-collected result."""
+    import subprocess
+
+    env = dict(os.environ, OPSAGENT_BENCH_MODEL=model)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        log(f"bench[{model}]: TIMED OUT after {timeout_s}s")
+        return None
+    sys.stderr.write(out.stderr)
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if "metric" in parsed:
+                return parsed
+        except json.JSONDecodeError:
+            continue
+    log(f"bench[{model}]: no JSON result (rc={out.returncode})")
+    return None
+
+
+def run_orchestrated() -> None:
+    """TPU default: bench-1b first (the safe, known-good configuration —
+    its weights are generated on device, no bulk transfer), then the
+    bench-8b headline (BASELINE.md names an 8B-class model). Prints ONE
+    JSON line: the 8B result when it completes, with the 1B number
+    alongside in extra; the 1B result otherwise."""
+    r1b = _run_child("bench-1b", timeout_s=1200)
+    r8b = _run_child("bench-8b", timeout_s=1500)
+    if r8b is not None:
+        if r1b is not None:
+            r8b.setdefault("extra", {})["bench_1b_tok_s_chip"] = r1b["value"]
+        print(json.dumps(r8b))
+    elif r1b is not None:
+        r1b.setdefault("extra", {})["bench_8b"] = "failed (see stderr)"
+        print(json.dumps(r1b))
+    else:
+        log("bench: both presets failed")
+        sys.exit(1)
+
+
+def run_single() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     n_chips = len(jax.devices())
@@ -127,14 +211,16 @@ def main() -> None:
     from opsagent_tpu.utils.profiling import trace
 
     block = eng.cfg.decode_block
-    t0 = time.perf_counter()
     produced = 0
     with trace():
+        # Clock inside the trace context: start_trace/stop_trace overhead
+        # (trace serialization takes seconds) must not deflate the number.
+        t0 = time.perf_counter()
         for _ in range(max(1, steps // block)):
             out = eng.step_block(ids)
             produced += sum(len(v) for v in out.values())
         produced += sum(len(v) for v in eng.drain().values())
-    dt = time.perf_counter() - t0
+        dt = time.perf_counter() - t0
 
     tok_s = produced / dt
     tok_s_chip = tok_s / n_chips

@@ -57,48 +57,37 @@ def _norm01(k, shape, fan_in, dtype):
     ).astype(dtype)
 
 
-def _init_attn_block(ks, cfg: ModelConfig, L: int, dtype) -> Params:
-    d, q, kv = cfg.hidden_size, cfg.q_size, cfg.kv_size
-
-    def norm01(k, shape, fan_in):
-        return _norm01(k, shape, fan_in, dtype)
-
-    block: Params = {
-        "attn_norm": jnp.ones((L, d), dtype),
-        "wq": norm01(next(ks), (L, d, q), d),
-        "wk": norm01(next(ks), (L, d, kv), d),
-        "wv": norm01(next(ks), (L, d, kv), d),
-        "wo": norm01(next(ks), (L, q, d), q),
-        "mlp_norm": jnp.ones((L, d), dtype),
-    }
-    if cfg.attn_bias:
-        block["bq"] = jnp.zeros((L, q), dtype)
-        block["bk"] = jnp.zeros((L, kv), dtype)
-        block["bv"] = jnp.zeros((L, kv), dtype)
-    return block
-
-
-def init_params(
-    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
-) -> Params:
-    """Random init (scaled normal). Real checkpoints come via models.loader."""
+def _build_tree(cfg: ModelConfig, ks, dtype, big, dense) -> Params:
+    """THE param-tree structure, shared by every initializer so it cannot
+    drift from ``param_specs``. ``big(key, shape, fan_in)`` makes the large
+    matmul weights (the quantizable set); ``dense(key, shape, fan_in, dt)``
+    makes the full-precision normal leaves (embed, router). Key-draw order
+    is part of the contract: golden fixtures pin ``init_params`` values."""
     d, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    q, kv = cfg.q_size, cfg.kv_size
     Ld, Lm = _layer_split(cfg)
-    ks = iter(jax.random.split(key, 32))
 
-    def norm01(k, shape, fan_in):
-        return _norm01(k, shape, fan_in, dtype)
-
-    layers = _init_attn_block(ks, cfg, Ld, dtype)
-    layers.update(
-        {
-            "wg": norm01(next(ks), (Ld, d, f), d),
-            "wu": norm01(next(ks), (Ld, d, f), d),
-            "wd": norm01(next(ks), (Ld, f, d), f),
+    def attn_block(L: int) -> Params:
+        block: Params = {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "wq": big(next(ks), (L, d, q), d),
+            "wk": big(next(ks), (L, d, kv), d),
+            "wv": big(next(ks), (L, d, kv), d),
+            "wo": big(next(ks), (L, q, d), q),
+            "mlp_norm": jnp.ones((L, d), dtype),
         }
-    )
+        if cfg.attn_bias:
+            block["bq"] = jnp.zeros((L, q), dtype)
+            block["bk"] = jnp.zeros((L, kv), dtype)
+            block["bv"] = jnp.zeros((L, kv), dtype)
+        return block
+
+    layers = attn_block(Ld)
+    layers["wg"] = big(next(ks), (Ld, d, f), d)
+    layers["wu"] = big(next(ks), (Ld, d, f), d)
+    layers["wd"] = big(next(ks), (Ld, f, d), f)
     params: Params = {
-        "embed": norm01(next(ks), (v, d), d),
+        "embed": dense(next(ks), (v, d), d, dtype),
         "layers": layers,
         "final_norm": jnp.ones((d,), dtype),
     }
@@ -106,27 +95,98 @@ def init_params(
         m = cfg.moe
         fe = m.expert_intermediate_size or f
         E = m.num_experts
-        moe_layers = _init_attn_block(ks, cfg, Lm, dtype)
-        moe_layers.update(
-            {
-                # Router stays f32: tiny, and top-k is precision-sensitive.
-                "router": jax.random.normal(
-                    next(ks), (Lm, d, E), jnp.float32
-                ) * (d ** -0.5),
-                "eg": norm01(next(ks), (Lm, E, d, fe), d),
-                "eu": norm01(next(ks), (Lm, E, d, fe), d),
-                "ed": norm01(next(ks), (Lm, E, fe, d), fe),
-            }
-        )
+        moe_layers = attn_block(Lm)
+        # Router stays f32: tiny, and top-k is precision-sensitive.
+        moe_layers["router"] = dense(next(ks), (Lm, d, E), d, jnp.float32)
+        moe_layers["eg"] = big(next(ks), (Lm, E, d, fe), d)
+        moe_layers["eu"] = big(next(ks), (Lm, E, d, fe), d)
+        moe_layers["ed"] = big(next(ks), (Lm, E, fe, d), fe)
         if m.num_shared_experts:
             fs = fe * m.num_shared_experts
-            moe_layers["sg"] = norm01(next(ks), (Lm, d, fs), d)
-            moe_layers["su"] = norm01(next(ks), (Lm, d, fs), d)
-            moe_layers["sd"] = norm01(next(ks), (Lm, fs, d), fs)
+            moe_layers["sg"] = big(next(ks), (Lm, d, fs), d)
+            moe_layers["su"] = big(next(ks), (Lm, d, fs), d)
+            moe_layers["sd"] = big(next(ks), (Lm, fs, d), fs)
         params["moe_layers"] = moe_layers
     if not cfg.tie_embeddings:
-        params["lm_head"] = norm01(next(ks), (d, v), d)
+        params["lm_head"] = big(next(ks), (d, v), d)
     return params
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random init (scaled normal). Real checkpoints come via models.loader."""
+    ks = iter(jax.random.split(key, 32))
+
+    def norm01(k, shape, fan_in):
+        return _norm01(k, shape, fan_in, dtype)
+
+    return _build_tree(
+        cfg, ks, dtype,
+        big=norm01,
+        dense=lambda k, shape, fan_in, dt: _norm01(k, shape, fan_in, dt),
+    )
+
+
+def init_params_random_int8(
+    cfg: ModelConfig, seed: int, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random weights built DIRECTLY in the int8 serving form
+    (models.quant.QuantizedLinear), ON DEVICE, without ever materializing a
+    full-precision tree and without any bulk host->device transfer.
+
+    Why both constraints matter at 8B scale:
+    - ``init_params`` + ``quantize_params`` needs a full-precision tree
+      (16 GB bf16 + f32 intermediates) that does not fit a 16 GB v5e chip,
+      and on the host backend the threefry RNG takes tens of minutes.
+    - Host-side numpy generation is fast, but then 8+ GB of weights must
+      cross the host->device link; on tunneled/remote-device setups that
+      transfer is the bottleneck (or worse). Generating on device moves
+      only PRNG keys.
+
+    Benchmarks and smoke runs only need *plausible* weights: q is uniform
+    int8 with a constant per-tensor scale chosen so the dequantized std
+    matches ``init_params``' fan-in scaling (std(U[-127,127]) = 127/sqrt3).
+    The whole tree is built by ONE jitted program; stacked weights are
+    filled with ``lax.map`` over per-layer keys so peak transient memory
+    is one layer slice, not a full-tensor wide intermediate.
+    """
+    from .quant import QuantizedLinear
+
+    def qrand(key, shape: tuple[int, ...], fan_in: int) -> QuantizedLinear:
+        lead, mat = shape[:-2], shape[-2:]
+
+        def gen(k):
+            # bits%255 in 0..254 minus 127 -> uniform int8 in [-127, 127]
+            # (the symmetric range quantize_weight produces; avoids the
+            # int8-overflow trap of randint(maxval=128)).
+            bits = jax.random.bits(k, mat, jnp.uint8)
+            return (bits.astype(jnp.int16) % 255 - 127).astype(jnp.int8)
+
+        if lead:
+            n = 1
+            for x in lead:
+                n *= x
+            q = jax.lax.map(gen, jax.random.split(key, n))
+            q = q.reshape(*lead, *mat)
+        else:
+            q = gen(key)
+        s = float(fan_in**-0.5) * (3.0**0.5) / 127.0
+        scale = jnp.full(lead + (1, mat[-1]), s, jnp.float32)
+        return QuantizedLinear(q, scale)
+
+    def build(key) -> Params:
+        ks = iter(jax.random.split(key, 32))
+        return _build_tree(
+            cfg, ks, dtype,
+            big=qrand,
+            # normal() in the target dtype directly: no f32 wide transient.
+            dense=lambda k, shape, fan_in, dt: (
+                jax.random.normal(k, shape, dt) * (fan_in**-0.5)
+            ),
+        )
+
+    return jax.jit(build)(jax.random.PRNGKey(seed))
 
 
 def _attn_block_specs(cfg: ModelConfig) -> Params:

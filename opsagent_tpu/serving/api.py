@@ -425,11 +425,11 @@ def build_engine_app(stack: ServingStack):
         return web.json_response(out)
 
     async def profile_start(request: web.Request) -> web.Response:
-        # On-demand jax.profiler capture around live traffic: POST with
-        # {"logdir": ...} (or rely on $OPSAGENT_PROFILE_DIR / --profile-dir)
-        # then hit /v1/profile/stop and open the dir in TensorBoard. The
-        # device-side complement to GET /api/perf/stats' host timers
-        # (reference only has the latter: pkg/api/router.go:104).
+        # On-demand jax.profiler capture around live traffic: POST (body
+        # ignored), then hit /v1/profile/stop and open the configured
+        # --profile-dir in TensorBoard. The device-side complement to
+        # GET /api/perf/stats' host timers (reference only has the
+        # latter: pkg/api/router.go:104).
         from ..utils.profiling import profile_dir
 
         # The trace destination is operator-configured only (--profile-dir

@@ -200,40 +200,58 @@ class Engine:
             )
         key = jax.random.PRNGKey(cfg.seed)
         specs = llama.param_specs(self.model_cfg)
-        # With quantization, weights must be built and quantized on the
-        # HOST: the full-precision tree is the thing that does not fit the
-        # chip (Llama-3-8B bf16 = 16 GB on a 16 GB v5e). Only the int8
-        # tree is device_put onto the mesh.
-        from contextlib import nullcontext
+        if cfg.quantize and params is None and not cfg.checkpoint:
+            # Random + int8 (benchmarks, smoke runs): build the int8 tree
+            # directly ON DEVICE — a full-precision host-side init +
+            # quantize takes tens of minutes at 8B, and shipping 8+ GB of
+            # host-generated weights over a tunneled device link is
+            # slower still (or kills the link).
+            from ..models.quant import quantize_specs
 
-        host = (
-            jax.default_device(jax.local_devices(backend="cpu")[0])
-            if cfg.quantize and params is None else nullcontext()
-        )
-        with host:
-            if params is None:
-                if cfg.checkpoint:
-                    from ..models.loader import load_checkpoint
+            log.warning(
+                "no checkpoint given: initializing RANDOM int8 weights "
+                "for %s", self.model_cfg.name,
+            )
+            params = llama.init_params_random_int8(
+                self.model_cfg, cfg.seed, dtype=cfg.dtype
+            )
+            specs = quantize_specs(specs)
+        else:
+            # With quantization, checkpoint weights must be loaded and
+            # quantized on the HOST: the full-precision tree is the thing
+            # that does not fit the chip (Llama-3-8B bf16 = 16 GB on a
+            # 16 GB v5e). Only the int8 tree is device_put onto the mesh.
+            from contextlib import nullcontext
 
-                    params = load_checkpoint(
-                        cfg.checkpoint, self.model_cfg, cfg.dtype
-                    )
-                else:
-                    log.warning(
-                        "no checkpoint given: initializing RANDOM weights for %s",
-                        self.model_cfg.name,
-                    )
-                    params = llama.init_params(
-                        self.model_cfg, key, dtype=cfg.dtype
-                    )
-            if cfg.quantize:
-                from ..models.quant import quantize_params, quantize_specs
+            host = (
+                jax.default_device(jax.local_devices(backend="cpu")[0])
+                if cfg.quantize and params is None else nullcontext()
+            )
+            with host:
+                if params is None:
+                    if cfg.checkpoint:
+                        from ..models.loader import load_checkpoint
 
-                params = quantize_params(params)
-                specs = quantize_specs(specs)
-                log.info(
-                    "weights quantized to int8 (per-output-channel scales)"
-                )
+                        params = load_checkpoint(
+                            cfg.checkpoint, self.model_cfg, cfg.dtype
+                        )
+                    else:
+                        log.warning(
+                            "no checkpoint given: initializing RANDOM "
+                            "weights for %s", self.model_cfg.name,
+                        )
+                        params = llama.init_params(
+                            self.model_cfg, key, dtype=cfg.dtype
+                        )
+                if cfg.quantize:
+                    from ..models.quant import quantize_params, quantize_specs
+
+                    params = quantize_params(params)
+                    specs = quantize_specs(specs)
+                    log.info(
+                        "weights quantized to int8 "
+                        "(per-output-channel scales)"
+                    )
         self.params = shard_params(params, specs, self.mesh)
         cache = llama.make_cache(
             self.model_cfg, cfg.num_pages, cfg.page_size, dtype=cfg.dtype
