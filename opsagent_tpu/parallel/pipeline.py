@@ -1,0 +1,226 @@
+"""Pipeline parallelism (the ``pp`` mesh axis): GPipe-style microbatch
+pipelining of the layer stack for training.
+
+The reference has no distributed layer at all (SURVEY.md §2.2); pp exists
+in this framework so the train step scales across the slow links: the mesh
+lays ``pp`` outermost (parallel/mesh.py), so stages map onto DCN across
+hosts/slices while each stage's tp/sp collectives stay on intra-slice ICI
+— activations cross the slow link once per stage boundary per microbatch,
+which is the only traffic pattern that tolerates DCN latency.
+
+tpu-first shape of the implementation:
+
+- ``jax.shard_map`` manual ONLY over ``pp`` (``axis_names={'pp'}``): the
+  pipeline schedule — who computes what, when activations move — is
+  explicit ``ppermute``; everything else (dp batch sharding, tp Megatron
+  splits, sp sequence sharding) stays on GSPMD auto-sharding inside the
+  stage, exactly as in the non-pipelined step.
+- The schedule is one ``lax.scan`` over M + PP - 1 ticks (static trip
+  count — no data-dependent Python control flow). At tick t, stage s runs
+  microbatch t - s; activations advance one stage per tick via a
+  non-cyclic ``ppermute``. The carry IS the pipeline register between
+  stages.
+- Stage-local layers: the stacked layer arrays are sharded over ``pp`` on
+  their leading (layer) axis (``param_specs_pp``), so each stage scans its
+  own L/PP layers — the same single traced layer body as the non-pipelined
+  path (models/llama._run_stack).
+- The loss head runs replicated after the scan: only the last stage's
+  collected activations are final-layer outputs; a scalar ``psum`` over
+  ``pp`` selects its loss sums. Non-last stages collect their OWN stage
+  outputs (mid-stack activations), compute a meaningless loss from them,
+  and have it zeroed by the ``where`` before the psum. This is safe
+  because those activations are finite — embeddings or zeros through a
+  finite-preserving stack — so neither the discarded forward value nor
+  its cotangent (0 * finite in the VJP) can produce NaN. Any schedule
+  extension must preserve that finiteness invariant: 0 * inf is NaN.
+
+GPipe (synchronous) rather than interleaved/1F1B: the bubble is
+(PP-1)/(M+PP-1), shrinking with more microbatches, and synchronous
+scheduling composes with ``jax.grad`` as plain autodiff through the scan —
+no hand-written backward schedule.
+
+MoE models: not supported under pp>1 yet (their layer stack is split
+dense-then-moe into two scans; staging across the two stacks needs a
+layout decision that EP should drive). ``make_pipeline_loss`` raises.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models import llama
+from ..models.config import ModelConfig
+
+
+def param_specs_pp(cfg: ModelConfig) -> Any:
+    """``models.llama.param_specs`` with the stacked layer arrays' leading
+    (layer) axis sharded over ``pp``: each pipeline stage holds only its
+    own layers. Embedding/head/final-norm stay replicated over pp (stage 0
+    embeds, the last stage projects; replication keeps the spec simple and
+    the arrays are small next to the layer stack)."""
+    specs = llama.param_specs(cfg)
+
+    def stage_shard(spec: P) -> P:
+        return P("pp", *spec[1:])
+
+    specs["layers"] = {
+        k: stage_shard(s) for k, s in specs["layers"].items()
+    }
+    if "moe_layers" in specs:
+        specs["moe_layers"] = {
+            k: stage_shard(s) for k, s in specs["moe_layers"].items()
+        }
+    return specs
+
+
+def make_pipeline_loss(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    microbatches: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+    remat: bool = False,
+) -> Callable:
+    """Build ``loss_fn(params, tokens [B,S], loss_mask [B,S]) ->
+    (loss, (ce, aux))`` running the layer stack as a PP-stage pipeline.
+    Drop-in for the trainer's dense loss path; params must be sharded with
+    ``param_specs_pp``. Requires L %% PP == 0 and B %% microbatches == 0.
+    """
+    if cfg.moe is not None:
+        raise NotImplementedError(
+            "pipeline parallelism currently supports dense models only "
+            "(MoE staging lands with expert parallelism)"
+        )
+    PP = mesh.shape["pp"]
+    M = microbatches
+    if cfg.num_layers % PP:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by pp={PP}"
+        )
+
+    def run_stage(stage_layers: Any, x: jax.Array, cos, sin) -> jax.Array:
+        mb, S = x.shape[:2]
+
+        def attn_fn(h, lp, kc, vc, li):
+            q, k, v = llama._qkv(h, lp, cfg)
+            from ..ops.rope import apply_rope
+
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            from ..ops.attention import causal_prefill_attention
+
+            attn = causal_prefill_attention(q, k, v)
+            return attn.reshape(mb, S, -1), kc, vc
+
+        x, _, _ = llama._run_stack(
+            {"layers": stage_layers}, cfg, x, attn_fn, cache=None,
+            remat=remat,
+        )
+        return x
+
+    def pipelined(params, tokens, loss_mask):
+        # Inside shard_map manual over (pp, dp): tokens are the per-dp-shard
+        # slice, so microbatching divides the LOCAL batch.
+        B, S = tokens.shape
+        if B % M:
+            raise ValueError(
+                f"per-dp batch {B} not divisible by microbatches {M}"
+            )
+        mb = B // M
+        stage = jax.lax.axis_index("pp")
+        is_last = stage == PP - 1
+
+        positions = jnp.arange(S)[None, :].repeat(mb, axis=0)
+        from ..ops.rope import rope_table
+
+        cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+
+        # Embedding is replicated over pp: every stage computes the same
+        # xs, only stage 0's enters the pipeline (the where below).
+        xs = params["embed"][tokens].astype(dtype)
+        xs = xs.reshape(M, mb, S, -1)
+
+        d = xs.shape[-1]
+        # pcast: the carry starts as constant zeros but becomes varying
+        # over the manual axes inside the scan (each stage and dp shard
+        # holds different activations); the varying-manual-axes type must
+        # match between scan input and output.
+        outs0 = jax.lax.pcast(
+            jnp.zeros((M, mb, S, d), dtype), ("pp", "dp"), to="varying"
+        )
+        reg0 = jax.lax.pcast(
+            jnp.zeros((mb, S, d), dtype), ("pp", "dp"), to="varying"
+        )  # pipeline register
+
+        def tick(carry, t):
+            reg, outs = carry
+            x_in = jnp.where(
+                stage == 0, xs[jnp.clip(t, 0, M - 1)], reg
+            )
+            h = run_stage(params["layers"], x_in, cos, sin)
+            # Advance the register one stage (non-cyclic: the last
+            # stage's h leaves the pipeline into outs instead).
+            reg = jax.lax.ppermute(
+                h, "pp", [(i, i + 1) for i in range(PP - 1)]
+            )
+            out_idx = t - (PP - 1)
+            valid = (out_idx >= 0) & (out_idx < M)
+            outs = outs.at[jnp.where(valid, out_idx, M)].set(
+                h, mode="drop"
+            )
+            return (reg, outs), None
+
+        (_, outs), _ = jax.lax.scan(
+            tick, (reg0, outs0), jnp.arange(M + PP - 1)
+        )
+
+        # Loss head, replicated: only the last stage's outs are final-layer
+        # activations; a scalar psum over pp selects its sums. Other
+        # stages' outs hold their own mid-stack activations — finite, so
+        # the where-discarded loss (and its 0-scaled cotangent) stays
+        # finite too. See the module docstring's finiteness invariant.
+        x = outs.reshape(B, S, d)
+        x = llama.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        logits = llama._lm_head(params, cfg, x)
+        logz = jax.nn.logsumexp(logits[:, :-1], axis=-1)
+        gold = jnp.take_along_axis(
+            logits[:, :-1], tokens[:, 1:][..., None], axis=-1
+        )[..., 0]
+        msk = loss_mask[:, 1:].astype(jnp.float32)
+        nll_sum = jnp.sum((logz - gold) * msk)
+        tok_cnt = jnp.sum(msk)
+        sums = jnp.where(
+            is_last, jnp.stack([nll_sum, tok_cnt]), jnp.zeros((2,))
+        )
+        # Global token-mean: over the pipeline (pick the last stage's sums)
+        # AND over dp shards (each saw its own batch slice).
+        sums = jax.lax.psum(sums, ("pp", "dp"))
+        ce = sums[0] / jnp.maximum(sums[1], 1.0)
+        return ce, (ce, jnp.zeros((), jnp.float32))
+
+    layer_specs = {k: P("pp") for k in llama.param_specs(cfg)["layers"]}
+    param_in_specs = {
+        "embed": P(),
+        "layers": layer_specs,
+        "final_norm": P(),
+    }
+    if not cfg.tie_embeddings:
+        param_in_specs["lm_head"] = P()
+
+    # Manual over pp AND dp (tp/sp stay on GSPMD auto-sharding inside the
+    # stage): dp must be manual here because XLA's SPMD partitioner cannot
+    # yet mix an auto dp batch dimension with manual-pp collectives (its
+    # AllReduceAlongShardingDims hits a device-group CHECK). Manual dp is
+    # the same math — shard_map's transpose inserts the gradient psum over
+    # dp for the replicated params, exactly what GSPMD would emit.
+    return jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(param_in_specs, P("dp"), P("dp")),
+        out_specs=(P(), (P(), P())),
+        axis_names={"pp", "dp"},
+    )

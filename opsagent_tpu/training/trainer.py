@@ -49,6 +49,9 @@ class TrainConfig:
     # sequence — peak memory O(S/sp) per device, enabling sequences that
     # cannot fit gathered. No-op on meshes with sp=1.
     ring_attention: bool = False
+    # Microbatches for GPipe pipelining when the mesh has pp > 1 (see
+    # parallel/pipeline.py). Bubble fraction = (pp-1)/(microbatches+pp-1).
+    pp_microbatches: int = 4
 
 
 def cross_entropy_loss(
@@ -86,9 +89,31 @@ def init_train_state(
     inherit the parameter shardings with no extra spec tree."""
     if params is None:
         params = llama.init_params(cfg, key, dtype=dtype)
-    params = shard_params(params, llama.param_specs(cfg), mesh)
+    params = shard_params(params, train_param_specs(cfg, mesh), mesh)
     opt_state = jax.jit(make_optimizer(tc).init)(params)
     return params, opt_state
+
+
+def train_param_specs(cfg: ModelConfig, mesh: Mesh) -> Any:
+    """Parameter PartitionSpecs for training on this mesh: pp-staged layer
+    stacks when the mesh pipelines, the serving specs otherwise. Validates
+    pipelineability HERE so unsupported configs fail with a clear error at
+    state-init time, not a cryptic device_put divisibility failure."""
+    pp = mesh.shape.get("pp", 1)
+    if pp > 1:
+        if cfg.moe is not None:
+            raise NotImplementedError(
+                "pipeline parallelism currently supports dense models only "
+                "(MoE staging lands with expert parallelism)"
+            )
+        if cfg.num_layers % pp:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} not divisible by pp={pp}"
+            )
+        from ..parallel.pipeline import param_specs_pp
+
+        return param_specs_pp(cfg)
+    return llama.param_specs(cfg)
 
 
 def make_train_step(
@@ -114,20 +139,30 @@ def make_train_step(
 
         prefill_attn = make_ring_attention(mesh)
 
-    def loss_fn(params, tokens, loss_mask):
-        # Attention runs over the full (evenly sp-shardable) sequence; the
-        # next-token shift happens on the logits. Slicing tokens to an odd
-        # length BEFORE the model makes XLA pad the sp shards unevenly, and
-        # the padded attention lanes (scores -1e30, squared in the backward)
-        # overflow to inf -> NaN grads. Shift-at-the-loss avoids it.
-        logits, aux = llama.forward_full(
-            params, cfg, tokens, dtype=dtype, remat=tc.remat, return_aux=True,
-            prefill_attn=prefill_attn,
+    if mesh.shape.get("pp", 1) > 1:
+        # GPipe microbatch pipeline over the pp axis (parallel/pipeline.py);
+        # params must carry param_specs_pp (init_train_state does).
+        from ..parallel.pipeline import make_pipeline_loss
+
+        loss_fn = make_pipeline_loss(
+            cfg, mesh, tc.pp_microbatches, dtype=dtype, remat=tc.remat
         )
-        ce = cross_entropy_loss(
-            logits[:, :-1], tokens[:, 1:], loss_mask[:, 1:]
-        )
-        return ce + tc.moe_aux_weight * aux, (ce, aux)
+    else:
+        def loss_fn(params, tokens, loss_mask):
+            # Attention runs over the full (evenly sp-shardable) sequence;
+            # the next-token shift happens on the logits. Slicing tokens to
+            # an odd length BEFORE the model makes XLA pad the sp shards
+            # unevenly, and the padded attention lanes (scores -1e30,
+            # squared in the backward) overflow to inf -> NaN grads.
+            # Shift-at-the-loss avoids it.
+            logits, aux = llama.forward_full(
+                params, cfg, tokens, dtype=dtype, remat=tc.remat,
+                return_aux=True, prefill_attn=prefill_attn,
+            )
+            ce = cross_entropy_loss(
+                logits[:, :-1], tokens[:, 1:], loss_mask[:, 1:]
+            )
+            return ce + tc.moe_aux_weight * aux, (ce, aux)
 
     def step(params, opt_state, tokens, loss_mask):
         (_, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
