@@ -82,6 +82,8 @@ def build_parser() -> argparse.ArgumentParser:
     se.add_argument("--checkpoint", default="", help="safetensors checkpoint dir")
     se.add_argument("--tokenizer", default="", help="HF tokenizer path (else byte tokenizer)")
     se.add_argument("--tp", type=int, default=0, help="tensor-parallel size (0 = all devices)")
+    se.add_argument("--sp", type=int, default=1, help="sequence-parallel size for long-context prefill (ragged ring attention)")
+    se.add_argument("--ep", type=int, default=1, help="expert-parallel size for MoE models (experts shard over ep)")
     se.add_argument("--max-batch-size", type=int, default=8)
     se.add_argument(
         "--quantize",
@@ -170,6 +172,8 @@ def main(argv: list[str] | None = None) -> int:
             checkpoint=args.checkpoint,
             tokenizer=args.tokenizer,
             tp=args.tp,
+            sp=args.sp,
+            ep=args.ep,
             max_batch_size=args.max_batch_size,
             quantize=args.quantize,
         )

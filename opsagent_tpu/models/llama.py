@@ -210,10 +210,12 @@ def param_specs(cfg: ModelConfig) -> Params:
 
     Column-parallel: wq/wk/wv/wg/wu (output dim over tp). Row-parallel:
     wo/wd (input dim over tp, XLA all-reduces the partial sums). Expert
-    weights split their intermediate dim over tp (column for eg/eu, row for
-    ed) — every expert runs tensor-parallel, which composes with the
-    scan-over-experts dispatch. Embedding sharded over vocab; lm_head over
-    vocab columns.
+    weights shard their EXPERT axis over ep (expert parallelism: each ep
+    shard holds E/ep experts, and the grouped dispatch's per-expert
+    buckets shard with them — XLA emits the token all-to-all from the
+    shardings) and their intermediate dim over tp (column for eg/eu, row
+    for ed) — every expert runs tensor-parallel, composing ep x tp.
+    Embedding sharded over vocab; lm_head over vocab columns.
     """
     layers = _attn_block_specs(cfg)
     layers.update(
@@ -234,9 +236,9 @@ def param_specs(cfg: ModelConfig) -> Params:
         moe_layers.update(
             {
                 "router": P(None, None, None),
-                "eg": P(None, None, None, "tp"),
-                "eu": P(None, None, None, "tp"),
-                "ed": P(None, None, "tp", None),
+                "eg": P(None, "ep", None, "tp"),
+                "eu": P(None, "ep", None, "tp"),
+                "ed": P(None, "ep", "tp", None),
             }
         )
         if cfg.moe.num_shared_experts:
@@ -270,6 +272,48 @@ def cache_specs(cfg: ModelConfig) -> Params:
 
 
 # -- building blocks --------------------------------------------------------
+def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint iff the ambient mesh has a real ep axis —
+    model code stays mesh-agnostic (tests call forward_full with no mesh
+    context at all) while ep>1 runs get the expert-sharded layout pinned
+    rather than left to GSPMD propagation (which is free to all-gather
+    the expert weights instead, defeating the memory scale-out).
+
+    The ``with mesh:`` context every caller uses (trainer/engine) is
+    ``pxla.thread_resources`` under the hood — read at TRACE time; the
+    accessor is deprecated but there is no public replacement readable
+    inside jit (``get_mesh`` forbids it, ``get_abstract_mesh`` is only
+    populated by ``use_mesh``, which this codebase does not adopt)."""
+    import warnings
+
+    mesh = None
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            from jax.interpreters import pxla
+
+            m = pxla.thread_resources.env.physical_mesh
+        if not m.empty:
+            mesh = m
+    except Exception:  # pragma: no cover - accessor removed upstream
+        mesh = None
+    if mesh is None:
+        am = jax.sharding.get_abstract_mesh()
+        if getattr(am, "axis_names", ()):
+            mesh = am
+    if (
+        mesh is not None
+        and "ep" in mesh.axis_names
+        and dict(mesh.shape).get("ep", 1) > 1
+    ):
+        if isinstance(mesh, jax.sharding.Mesh):
+            return jax.lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(mesh, spec)
+            )
+        return jax.lax.with_sharding_constraint(x, spec)
+    return x
+
+
 def _mm(x: jax.Array, w: Any) -> jax.Array:
     """Matmul against a plain array or a weight-only-int8 QuantizedLinear
     (models.quant): the dequantize multiplies fuse into the matmul operand
@@ -427,10 +471,15 @@ def _moe_grouped_dispatch(
     disp = jnp.zeros((E * C, d), h.dtype).at[dest].set(
         x[token_of], mode="drop"
     ).reshape(E, C, d)
+    # Expert parallelism: pin the bucket and output layouts to the expert
+    # axis so XLA partitions expert compute over ep and emits the token
+    # all-to-all at the scatter/gather boundaries (no-op on ep=1 meshes).
+    disp = _ep_constrain(disp, P("ep", None, None))
     up = jax.nn.silu(
         _ein("ecd,edf->ecf", disp, lp["eg"])
     ) * _ein("ecd,edf->ecf", disp, lp["eu"])
     y = _ein("ecf,efd->ecd", up, lp["ed"])             # [E, C, d]
+    y = _ep_constrain(y, P("ep", None, None))
     y = y.reshape(E * C, d)
     # Gather each assignment's routed output; dropped slots contribute 0.
     safe = jnp.where(keep, dest, 0)

@@ -5,11 +5,12 @@ The reference has no distributed layer at all (SURVEY.md section 2.2); this
 module is the foundation of the new framework's TPU story: a named
 ``jax.sharding.Mesh`` with axes
 
-- ``pp``  — pipeline parallel (layer stages; size 1 until stages land, but
-  the axis exists so stage sharding is an annotation change, not a mesh
-  redesign — SURVEY §2.2 "design the mesh so PP can be added"),
+- ``pp``  — pipeline parallel (GPipe layer stages, parallel/pipeline.py),
 - ``dp``  — data/batch parallel (concurrent agent sessions),
 - ``sp``  — sequence/context parallel (long-context prefill, ring attention),
+- ``ep``  — expert parallel (MoE expert dimension; the grouped dispatch's
+  per-expert buckets shard over it, so expert weights AND expert compute
+  scale out — the DeepSeek-V3-class configuration),
 - ``tp``  — tensor parallel (attention heads / MLP hidden, over ICI).
 
 Axis ORDER encodes the network topology: outer axes map to the slower
@@ -43,6 +44,7 @@ class MeshAxes:
     dp: str = "dp"
     tp: str = "tp"
     sp: str = "sp"
+    ep: str = "ep"
 
 
 AXES = MeshAxes()
@@ -87,27 +89,31 @@ def make_mesh(
     dp: int = 1,
     sp: int = 1,
     pp: int = 1,
+    ep: int = 1,
     devices: list[Any] | None = None,
 ) -> Mesh:
-    """Build a (pp, dp, sp, tp) mesh. ``tp=None`` uses all remaining
+    """Build a (pp, dp, sp, ep, tp) mesh. ``tp=None`` uses all remaining
     devices. Axis order puts pp/dp outermost so they land on the slowest
-    links (DCN across slices) and sp/tp innermost (ICI)."""
+    links (DCN across slices) and ep/tp innermost (ICI — the MoE
+    all-to-all and the Megatron all-reduces are the latency-critical
+    collectives)."""
     devs = devices if devices is not None else jax.devices()
     n = len(devs)
     if tp is None or tp <= 0:
-        if n % (pp * dp * sp) != 0:
+        if n % (pp * dp * sp * ep) != 0:
             raise ValueError(
-                f"{n} devices not divisible by pp*dp*sp={pp * dp * sp}"
+                f"{n} devices not divisible by pp*dp*sp*ep="
+                f"{pp * dp * sp * ep}"
             )
-        tp = n // (pp * dp * sp)
-    need = pp * dp * sp * tp
+        tp = n // (pp * dp * sp * ep)
+    need = pp * dp * sp * ep * tp
     if need > n:
         raise ValueError(
-            f"mesh pp={pp} dp={dp} sp={sp} tp={tp} needs {need} devices, "
-            f"have {n}"
+            f"mesh pp={pp} dp={dp} sp={sp} ep={ep} tp={tp} needs {need} "
+            f"devices, have {n}"
         )
-    grid = np.array(devs[:need]).reshape(pp, dp, sp, tp)
-    return Mesh(grid, (AXES.pp, AXES.dp, AXES.sp, AXES.tp))
+    grid = np.array(devs[:need]).reshape(pp, dp, sp, ep, tp)
+    return Mesh(grid, (AXES.pp, AXES.dp, AXES.sp, AXES.ep, AXES.tp))
 
 
 def replicate(mesh: Mesh) -> NamedSharding:

@@ -46,7 +46,6 @@ layout decision that EP should drive). ``make_pipeline_loss`` raises.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax

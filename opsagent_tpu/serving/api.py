@@ -479,6 +479,8 @@ def run_engine_server(
     checkpoint: str = "",
     tokenizer: str = "",
     tp: int = 0,
+    sp: int = 1,
+    ep: int = 1,
     max_batch_size: int = 8,
     quantize: str = "",
 ) -> None:
@@ -489,6 +491,8 @@ def run_engine_server(
         checkpoint=checkpoint,
         tokenizer=tokenizer,
         tp=tp,
+        sp=sp,
+        ep=ep,
         max_batch_size=max_batch_size,
         quantize=quantize,
         # Production server: compile everything before accepting requests

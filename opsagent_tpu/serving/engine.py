@@ -93,6 +93,10 @@ class EngineConfig:
     # cache and stay on the pjit-partitioned gather path; decode has no
     # sequence axis to shard.
     sp: int = 1
+    # Expert parallelism for MoE models: experts (weights AND grouped-
+    # dispatch compute) shard over the ep mesh axis (DeepSeek-V3-class
+    # scale-out). No effect on dense models.
+    ep: int = 1
     page_size: int = 16
     num_pages: int = 2048
     max_pages_per_seq: int = 320   # 5120 tokens: largest bucket + generation
@@ -170,7 +174,7 @@ class Engine:
             cfg.tokenizer, vocab_size=self.model_cfg.vocab_size
         )
         n_dev = len(jax.devices())
-        slots = cfg.dp * cfg.sp
+        slots = cfg.dp * cfg.sp * cfg.ep
         if cfg.sp > 1:
             # Fail fast with the config knob named, instead of an opaque
             # shard_map divisibility error at first prefill.
@@ -182,8 +186,8 @@ class Engine:
                 )
             if slots * max(1, cfg.tp) > n_dev:
                 raise ValueError(
-                    f"dp={cfg.dp} * sp={cfg.sp} * tp={max(1, cfg.tp)} "
-                    f"exceeds {n_dev} devices"
+                    f"dp={cfg.dp} * sp={cfg.sp} * ep={cfg.ep} * "
+                    f"tp={max(1, cfg.tp)} exceeds {n_dev} devices"
                 )
         tp = cfg.tp if cfg.tp > 0 else max(
             1, n_dev // slots if n_dev % slots == 0 else 1
@@ -191,7 +195,7 @@ class Engine:
         # kv heads must divide cleanly over tp; fall back gracefully.
         while tp > 1 and self.model_cfg.num_kv_heads % tp != 0:
             tp -= 1
-        self.mesh = make_mesh(tp=tp, dp=cfg.dp, sp=cfg.sp)
+        self.mesh = make_mesh(tp=tp, dp=cfg.dp, sp=cfg.sp, ep=cfg.ep)
         self.lock = threading.RLock()
 
         if cfg.quantize and cfg.quantize != "int8":

@@ -74,13 +74,15 @@ def test_graft_dryrun_multichip_8():
 
 
 def test_mesh_has_pp_axis_and_distributed_noop():
-    """SURVEY §2.2: the mesh names a pp axis (size 1 until pipeline stages
-    land) so PP is an annotation change, not a mesh redesign; and
+    """SURVEY §2.2: the mesh names every parallelism axis (pp/dp/sp/ep/tp)
+    so adding a strategy is an annotation change, not a mesh redesign; and
     init_distributed is a no-op single-host."""
     from opsagent_tpu.parallel.mesh import init_distributed, make_mesh
 
     mesh = make_mesh(tp=2, dp=2, sp=2)
-    assert dict(mesh.shape) == {"pp": 1, "dp": 2, "sp": 2, "tp": 2}
+    assert dict(mesh.shape) == {
+        "pp": 1, "dp": 2, "sp": 2, "ep": 1, "tp": 2
+    }
     mesh2 = make_mesh(tp=1, dp=1, sp=1, pp=2, devices=jax.devices()[:2])
     assert mesh2.shape["pp"] == 2
     assert init_distributed() == 1  # no coordinator env: single host
