@@ -36,11 +36,29 @@ log = get_logger("serving.api")
 class ServingStack:
     """Engine + scheduler + chat glue for one hosted model."""
 
-    def __init__(self, engine: Engine):
-        self.engine = engine
-        self.scheduler = Scheduler(engine)
+    def __init__(self, engine: Engine, restart_tolerant: bool = True):
+        # Slice-restart tolerance: the scheduler rebuilds a fresh engine
+        # from the same config if the device runtime fails persistently,
+        # re-admitting in-flight work (scheduler._recover). ``engine``
+        # is a property so restarts are transparent to every consumer.
+        factory = (lambda cfg=engine.cfg: Engine(cfg)) if restart_tolerant else None
+        self.scheduler = Scheduler(engine, engine_factory=factory)
         self.scheduler.start()
         self.model_name = engine.model_cfg.name
+
+    @property
+    def engine(self) -> Engine:
+        # Direct assignment (tests wiring a fake engine without a
+        # scheduler) takes precedence; otherwise track the scheduler's
+        # current engine so restarts are transparent.
+        override = getattr(self, "_engine_override", None)
+        if override is not None:
+            return override
+        return self.scheduler.engine
+
+    @engine.setter
+    def engine(self, value: Engine) -> None:
+        self._engine_override = value
 
     # -- request translation ------------------------------------------------
     def _translate(

@@ -50,15 +50,38 @@ class Request:
     error_status: int = 500  # meaningful only when error is set
     done = None  # threading.Event, set in __post_init__
     enqueued_s: float = field(default_factory=time.perf_counter)
+    # Tokens generated before an engine restart (slice-restart tolerance):
+    # re-admission folds them into the prompt, and the final result is
+    # generated_prefix + the post-restart generation.
+    generated_prefix: list[int] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         self.done = threading.Event()
 
 
 class Scheduler:
-    def __init__(self, engine: Engine, admission_timeout_s: float = 120.0):
+    def __init__(
+        self,
+        engine: Engine,
+        admission_timeout_s: float = 120.0,
+        engine_factory: Callable[[], Engine] | None = None,
+        max_restarts: int = 3,
+    ):
+        """``engine_factory`` enables slice-restart tolerance (SURVEY §5):
+        when the engine fails persistently (a restarted TPU slice, a wedged
+        device runtime), the scheduler rebuilds the engine via the factory
+        and RE-ADMITS every in-flight request from its retained prompt +
+        tokens generated so far, instead of failing the batch. At most
+        ``max_restarts`` rebuilds per scheduler lifetime; without a
+        factory, persistent failure fails the in-flight requests (the
+        reference's equivalent is k8s probe-driven pod restart, reference
+        deploy/kubernetes/deployment-prod.yaml probes — here recovery is
+        in-process and keeps queued work)."""
         self.engine = engine
         self.admission_timeout_s = admission_timeout_s
+        self._engine_factory = engine_factory
+        self._max_restarts = max_restarts
+        self._restarts = 0
         self._queue: "queue.Queue[Request]" = queue.Queue()
         self._waiting: list[Request] = []
         self._prefilling: dict[int, Request] = {}  # begun, chunks pending
@@ -189,13 +212,87 @@ class Scheduler:
         for sid in finished:
             req = self._running.pop(sid)
             req.finish_reason = self.engine.sequences[sid].finish_reason
-            req.tokens = self.engine.finish(sid)
+            req.tokens = req.generated_prefix + self.engine.finish(sid)
             if req.finish_reason == "error":
                 # The engine terminated this sequence on a raising stream
                 # callback (client went away mid-stream). Only THIS request
                 # fails; the rest of the batch keeps decoding.
                 req.error = "stream callback failed"
             req.done.set()
+
+    def _recover(self) -> None:
+        """Slice-restart tolerance: rebuild the engine and re-admit every
+        in-flight request from retained host state (SURVEY §5's "queue
+        drain + re-prefill from retained prompts").
+
+        For each running sequence, whatever tokens the dying engine's host
+        state still exposes are salvaged into ``generated_prefix``; the
+        request re-enters the admission queue with prompt = original
+        prompt + salvaged tokens (so the re-prefill rebuilds its full
+        context, prefix cache making it cheap when pages survive), a
+        correspondingly reduced max_tokens budget, and — for constrained
+        decoding — a mask_fn wrapped so the FSM keeps walking from where
+        it was instead of restarting at the schema root. Streaming clients
+        notice nothing: already-delivered tokens are not re-sent."""
+        from dataclasses import replace as dc_replace
+
+        self._restarts += 1
+        log.error(
+            "engine restart %d/%d: rebuilding device state, re-admitting "
+            "%d running + %d prefilling requests",
+            self._restarts, self._max_restarts,
+            len(self._running), len(self._prefilling),
+        )
+        salvaged: list[Request] = []
+        for sid, req in list(self._running.items()):
+            partial: list[int] = []
+            try:
+                partial = self.engine.finish(sid)
+            except Exception:  # noqa: BLE001 - device state may be gone
+                pass
+            req.generated_prefix = req.generated_prefix + partial
+            budget = req.sampling.max_tokens - len(req.generated_prefix)
+            if budget <= 0:
+                req.tokens = req.generated_prefix
+                req.finish_reason = "length"
+                req.done.set()
+                continue
+            req.prompt_ids = req.prompt_ids + partial
+            req.sampling = dc_replace(req.sampling, max_tokens=budget)
+            if req.mask_fn is not None and partial:
+                # Wrap with only THIS restart's salvage: after a second
+                # restart the inner fn already prepends the earlier
+                # salvage, so prepending the cumulative prefix would feed
+                # the FSM earlier tokens twice.
+                inner = req.mask_fn
+                req.mask_fn = (
+                    lambda toks, _p=list(partial), _f=inner: _f(_p + toks)
+                )
+            req.seq_id = None
+            salvaged.append(req)
+        self._running.clear()
+        for sid, req in list(self._prefilling.items()):
+            # Not decoding yet: nothing generated, just re-admit whole.
+            req.seq_id = None
+            salvaged.append(req)
+        self._prefilling.clear()
+        for req in salvaged:
+            # The time already spent generating must not count against the
+            # ADMISSION timeout of the re-admission.
+            req.enqueued_s = time.perf_counter()
+        # Oldest first so re-admitted work keeps its queue position.
+        self._waiting = salvaged + self._waiting
+        try:
+            self.engine = self._engine_factory()
+        except Exception:  # noqa: BLE001 - slice may still be restarting
+            # Keep the old engine reference: admission is host-side, so
+            # queued work is not insta-failed; the next persistent device
+            # failure triggers another recovery attempt (until the
+            # restart budget runs out).
+            log.exception(
+                "engine rebuild failed; keeping queued work for the next "
+                "recovery attempt"
+            )
 
     def _loop(self) -> None:
         log.info("scheduler loop started (batch=%d)", self.engine.cfg.max_batch_size)
@@ -235,11 +332,24 @@ class Scheduler:
                 consecutive_failures += 1
                 if consecutive_failures < 3:
                     continue
+                if (
+                    self._engine_factory is not None
+                    and self._restarts < self._max_restarts
+                ):
+                    self._recover()
+                    consecutive_failures = 0
+                    continue
                 log.error("engine failing persistently; failing in-flight requests")
                 for sid, req in list(self._running.items()):
                     req.error = f"engine step failed: {e}"
+                    # Earlier restarts' salvage was already streamed to the
+                    # client; keep it in the result even if the dead
+                    # engine's finish() raises.
+                    req.tokens = list(req.generated_prefix)
                     try:
-                        req.tokens = self.engine.finish(sid)
+                        req.tokens = (
+                            req.generated_prefix + self.engine.finish(sid)
+                        )
                     except Exception:  # noqa: BLE001
                         pass
                     req.done.set()
@@ -258,7 +368,7 @@ class Scheduler:
             req.done.set()
         self._prefilling.clear()
         for sid, req in list(self._running.items()):
-            req.tokens = self.engine.finish(sid)
+            req.tokens = req.generated_prefix + self.engine.finish(sid)
             req.error = "scheduler stopped"
             req.done.set()
         self._running.clear()

@@ -3,4 +3,10 @@ from .trainer import (  # noqa: F401
     cross_entropy_loss,
     init_train_state,
     make_train_step,
+    train_param_specs,
+)
+from .checkpoint import (  # noqa: F401
+    latest_step,
+    restore_train_state,
+    save_train_state,
 )

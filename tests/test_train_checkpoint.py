@@ -1,0 +1,98 @@
+"""Training checkpoint/resume (training/checkpoint.py, orbax-backed) on the
+virtual 8-device mesh: save -> restore must round-trip sharded state
+exactly, and a resumed run must continue identically to an uninterrupted
+one (SURVEY §5 checkpoint/resume — absent in the reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.models.config import get_config_preset
+from opsagent_tpu.parallel.mesh import make_mesh
+from opsagent_tpu.training import (
+    TrainConfig,
+    init_train_state,
+    latest_step,
+    make_train_step,
+    restore_train_state,
+    save_train_state,
+)
+
+CFG = get_config_preset("tiny-test")
+TC = TrainConfig(learning_rate=3e-3, remat=False)
+
+
+def _data(seed=1, B=4, S=16):
+    tokens = jnp.asarray(
+        np.random.default_rng(seed).integers(1, 500, (B, S)), jnp.int32
+    )
+    return tokens, jnp.ones((B, S), jnp.float32)
+
+
+def test_save_restore_roundtrip_and_identical_resume(tmp_path):
+    mesh = make_mesh(tp=2, dp=2, sp=2)
+    params, opt_state = init_train_state(
+        CFG, TC, mesh, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    step = make_train_step(CFG, TC, mesh, dtype=jnp.float32)
+    tokens, mask = _data()
+
+    # Uninterrupted run: 2 steps, checkpoint after the first. The step
+    # donates its inputs, so snapshot host copies before continuing.
+    params, opt_state, _ = step(params, opt_state, tokens, mask)
+    save_train_state(str(tmp_path), 1, params, opt_state)
+    saved_host = [np.asarray(x) for x in jax.tree.leaves(params)]
+    saved_shardings = [x.sharding for x in jax.tree.leaves(params)]
+    p_cont, o_cont, m_cont = step(params, opt_state, tokens, mask)
+    cont_host = [np.asarray(x) for x in jax.tree.leaves(p_cont)]
+
+    # Resume from disk into FRESH sharded state and take the same step.
+    p0, o0 = init_train_state(
+        CFG, TC, mesh, jax.random.PRNGKey(99), dtype=jnp.float32
+    )
+    p_res, o_res, got_step = restore_train_state(str(tmp_path), p0, o0)
+    assert got_step == 1
+    for a, want, sh in zip(
+        jax.tree.leaves(p_res), saved_host, saved_shardings
+    ):
+        # placement restored, not host-side (P() vs P(None) are the same
+        # replicated layout, so compare by equivalence)
+        assert a.sharding.is_equivalent_to(sh, a.ndim)
+        assert np.array_equal(np.asarray(a), want)
+    p_res2, o_res2, m_res = step(p_res, o_res, tokens, mask)
+    assert float(m_res["loss"]) == float(m_cont["loss"])
+    for a, want in zip(jax.tree.leaves(p_res2), cont_host):
+        assert np.array_equal(np.asarray(a), want)
+
+
+def test_restore_onto_different_mesh(tmp_path):
+    """Orbax reshards on read: a checkpoint saved on one mesh restores
+    onto another topology (elastic resume after a slice-size change)."""
+    mesh_a = make_mesh(tp=2, dp=2, sp=2)
+    params, opt_state = init_train_state(
+        CFG, TC, mesh_a, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    save_train_state(str(tmp_path), 3, params, opt_state)
+
+    mesh_b = make_mesh(tp=4, dp=2, sp=1)
+    p0, o0 = init_train_state(
+        CFG, TC, mesh_b, jax.random.PRNGKey(7), dtype=jnp.float32
+    )
+    p_res, _, got = restore_train_state(str(tmp_path), p0, o0)
+    assert got == 3
+    for a, b in zip(jax.tree.leaves(p_res), jax.tree.leaves(params)):
+        assert jnp.array_equal(a, b)
+
+
+def test_latest_step_and_missing(tmp_path):
+    assert latest_step(str(tmp_path)) is None
+    with pytest.raises(FileNotFoundError):
+        restore_train_state(str(tmp_path), {}, {})
+    mesh = make_mesh(tp=2, dp=2, sp=2)
+    params, opt_state = init_train_state(
+        CFG, TC, mesh, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    save_train_state(str(tmp_path), 1, params, opt_state)
+    save_train_state(str(tmp_path), 10, params, opt_state)
+    assert latest_step(str(tmp_path)) == 10
