@@ -84,6 +84,12 @@ def build_parser() -> argparse.ArgumentParser:
     se.add_argument("--tp", type=int, default=0, help="tensor-parallel size (0 = all devices)")
     se.add_argument("--sp", type=int, default=1, help="sequence-parallel size for long-context prefill (ragged ring attention)")
     se.add_argument("--ep", type=int, default=1, help="expert-parallel size for MoE models (experts shard over ep)")
+    se.add_argument(
+        "--speculative-k", type=int, default=0,
+        help="prompt-lookup speculative decoding: draft k tokens per decode "
+             "iteration from the sequence's own history (exact for greedy; "
+             "agent JSON loops accept most drafts). 0 disables",
+    )
     se.add_argument("--max-batch-size", type=int, default=8)
     se.add_argument(
         "--quantize",
@@ -176,6 +182,7 @@ def main(argv: list[str] | None = None) -> int:
             ep=args.ep,
             max_batch_size=args.max_batch_size,
             quantize=args.quantize,
+            speculative_k=args.speculative_k,
         )
         return 0
 

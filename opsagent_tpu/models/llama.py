@@ -625,6 +625,49 @@ def prefill_with_prefix(
     return logits, cache
 
 
+def verify_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B, S] int32 draft-chunk inputs
+    start: jax.Array,        # [B] write offset (tokens already in cache)
+    valid: jax.Array,        # [B] valid chunk lengths (KV writes + attn)
+    cache: Params,
+    page_table: jax.Array,   # [B, MaxP]
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jax.Array, Params]:
+    """Speculative-decoding verify forward: process an S-token draft chunk
+    per row in ONE pass, returning logits for EVERY chunk position
+    [B, S, V] (``prefill_with_prefix`` with the last-position gather
+    removed). Each position's argmax is the model's true greedy
+    continuation given the chunk prefix before it — the acceptance test
+    for prompt-lookup drafts. KV for all S positions is written at
+    ``start``; rejected positions simply get overwritten by later real
+    tokens, because the write offset only advances by the accepted count.
+    Costs ~one decode step of HBM traffic (weights stream once per
+    forward, the whole point of speculation)."""
+    B, S = tokens.shape
+    positions = start[:, None] + jnp.arange(S)[None, :]
+    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+    x = params["embed"][tokens].astype(dtype)
+
+    def attn_fn(h, lp, kc, vc, li):
+        q, k, v = _qkv(h, lp, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kc, vc = write_kv_pages(
+            kc, vc, k, v, page_table, start, valid_len=valid, layer=li
+        )
+        attn = paged_prefix_attention(
+            q, kc, vc, page_table, start, valid, layer=li
+        )
+        return attn.reshape(B, S, -1), kc, vc
+
+    x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _lm_head(params, cfg, x)
+    return logits, cache
+
+
 def decode_step(
     params: Params,
     cfg: ModelConfig,

@@ -148,3 +148,144 @@ def decode_block_carry(
         jnp.arange(n_steps),
     )
     return toks.T, cache, (tok, at, eos, key)
+
+
+# -- speculative decoding (prompt-lookup / n-gram drafting) ------------------
+def ngram_draft(
+    hist: jax.Array,    # [B, H] token history (prompt + accepted generation)
+    at: jax.Array,      # [B] written-token counts (hist[:, :at] is real)
+    tok: jax.Array,     # [B] next input token (not yet in hist)
+    k: int,             # draft length
+    ngram: int,         # match-gram length (includes tok as its last item)
+) -> jax.Array:
+    """Prompt-lookup drafting, fully on device: find the LAST earlier
+    occurrence of the trailing ``ngram`` (history tail + tok) and propose
+    the k tokens that followed it. Agent ReAct loops re-emit the same JSON
+    scaffolding every iteration, so lookups hit constantly. Rows with no
+    match draft pad-like junk that simply fails verification (costing
+    nothing extra — the verify forward runs regardless). Returns
+    [B, k] draft tokens."""
+    B, H = hist.shape
+    g = ngram
+    gpos = at[:, None] - (g - 1) + jnp.arange(g)[None, :]          # [B, g]
+    gram = jnp.take_along_axis(hist, jnp.clip(gpos, 0, H - 1), axis=1)
+    gram = gram.at[:, -1].set(tok)
+    W = H - g + 1
+    eq = jnp.ones((B, W), bool)
+    for i in range(g):
+        eq &= hist[:, i : W + i] == gram[:, i : i + 1]
+    jpos = jnp.arange(W)[None, :]
+    # The candidate window must end strictly before the current tail gram
+    # (else it matches itself), and its draft must be written history.
+    ok = eq & (jpos + g + k - 1 <= at[:, None] - 1)
+    j = jnp.max(jnp.where(ok, jpos, -1), axis=1)                   # [B]
+    dpos = j[:, None] + g + jnp.arange(k)[None, :]
+    draft = jnp.take_along_axis(hist, jnp.clip(dpos, 0, H - 1), axis=1)
+    return jnp.where(j[:, None] >= 0, draft, -1)
+
+
+def speculative_block_carry(
+    params: Any,
+    cfg: ModelConfig,
+    carry_tok: jax.Array,   # [B] int32 last sampled (not yet written) token
+    carry_at: jax.Array,    # [B] int32 tokens already written to cache
+    carry_eos: jax.Array,   # [B] bool
+    carry_hist: jax.Array,  # [B, H] int32 device-resident token history
+    override: jax.Array,    # [B] bool  lane newly (re)assigned
+    ov_tok: jax.Array,      # [B] int32
+    ov_at: jax.Array,       # [B] int32
+    ov_hist: jax.Array,     # [B, H] int32 host-supplied history for overrides
+    alive: jax.Array,       # [B] bool
+    budgets: jax.Array,     # [B] int32 max tokens this dispatch may emit
+    cache: Any,             # paged KV pytree (donated)
+    page_table: jax.Array,  # [B, MaxP]
+    eos_id: jax.Array,
+    pad_id: jax.Array,
+    n_steps: int,           # scan iterations (each emits 1..k+1 tokens)
+    k: int,                 # draft tokens per iteration
+    ngram: int = 2,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array, Any, tuple]:
+    """GREEDY decode with prompt-lookup speculation, device-resident like
+    ``decode_block_carry``: each scan step drafts k tokens from the row's
+    own history, verifies them in ONE multi-position forward
+    (llama.verify_step), and emits the accepted prefix + the model's own
+    next token — 1 to k+1 tokens per weight-streaming pass. Emission stops
+    at EOS and at the per-dispatch budget; KV written for rejected draft
+    positions is overwritten later (write offset advances by accepted
+    count only), and writes past the booked pages land on -1 table slots,
+    which drop.
+
+    Returns (tokens [B, n_steps, k+1] — pad past each step's count —,
+    counts [B, n_steps], cache, new carry (tok, at, eos, hist)).
+    """
+    B = carry_tok.shape[0]
+    tok = jnp.where(override, ov_tok, carry_tok).astype(jnp.int32)
+    at = jnp.where(override, ov_at, carry_at).astype(jnp.int32)
+    eos = jnp.where(override, False, carry_eos)
+    hist = jnp.where(override[:, None], ov_hist, carry_hist).astype(jnp.int32)
+    iota = jnp.arange(k + 1)[None, :]
+
+    def body(carry, _):
+        tok, at, eos, act, emitted, cache, hist = carry
+        rem = budgets - emitted
+        draft = ngram_draft(hist, at, tok, k, ngram)
+        inputs = jnp.concatenate([tok[:, None], draft], axis=1)    # [B, k+1]
+        valid = jnp.where(act, jnp.minimum(k + 1, rem), 0)
+        logits, cache = llama.verify_step(
+            params, cfg, inputs, at, valid, cache, page_table, dtype=dtype
+        )
+        a = jnp.argmax(logits, axis=-1).astype(jnp.int32)          # [B, k+1]
+        match = (draft == a[:, :k]).astype(jnp.int32)
+        prefix_ok = jnp.cumprod(match, axis=1)                     # [B, k]
+        can = jnp.concatenate(
+            [jnp.ones((B, 1), jnp.int32), prefix_ok], axis=1
+        )                                                          # [B, k+1]
+        no_eos_before = jnp.cumprod(
+            jnp.concatenate(
+                [jnp.ones((B, 1), jnp.int32),
+                 (a[:, :k] != eos_id).astype(jnp.int32)],
+                axis=1,
+            ),
+            axis=1,
+        )
+        emit = (
+            (can * no_eos_before) > 0
+        ) & (iota < rem[:, None]) & act[:, None]
+        n_emit = jnp.sum(emit, axis=1).astype(jnp.int32)           # [B]
+        out_toks = jnp.where(emit, a, pad_id).astype(jnp.int32)
+        eos_new = eos | jnp.any(emit & (a == eos_id), axis=1)
+        # History: the ACCEPTED inputs land at positions at..at+n_emit-1.
+        wpos = at[:, None] + iota
+        H = hist.shape[1]
+        hpos = jnp.where(
+            (iota < n_emit[:, None]) & (wpos < H), wpos, H
+        )
+        hist = jax.vmap(
+            lambda h, p, v: h.at[p].set(v, mode="drop")
+        )(hist, hpos, inputs)
+        last = jnp.take_along_axis(
+            a, jnp.clip(n_emit - 1, 0, k)[:, None], axis=1
+        )[:, 0]
+        tok = jnp.where(n_emit > 0, last, tok)
+        at = at + n_emit
+        emitted = emitted + n_emit
+        act = act & ~eos_new & (emitted < budgets)
+        return (tok, at, eos_new, act, emitted, cache, hist), (
+            out_toks, n_emit
+        )
+
+    act0 = alive & ~eos & (budgets > 0)
+    (tok, at, eos, _, _, cache, hist), (toks, counts) = jax.lax.scan(
+        body,
+        (tok, at, eos, act0, jnp.zeros((B,), jnp.int32), cache, hist),
+        None,
+        length=n_steps,
+    )
+    # scan stacks leading: toks [n_steps, B, k+1] -> [B, n_steps, k+1].
+    return (
+        jnp.transpose(toks, (1, 0, 2)),
+        counts.T,
+        cache,
+        (tok, at, eos, hist),
+    )

@@ -97,6 +97,15 @@ class EngineConfig:
     # dispatch compute) shard over the ep mesh axis (DeepSeek-V3-class
     # scale-out). No effect on dense models.
     ep: int = 1
+    # Speculative decoding (prompt-lookup / n-gram drafting): draft this
+    # many tokens per decode iteration from the sequence's own history and
+    # verify them in one multi-position forward — 1..k+1 tokens per
+    # weight-streaming pass. Exact for greedy sampling (the agent-loop
+    # default); non-greedy batches fall back to the vanilla pipeline.
+    # Agent ReAct loops re-emit the same JSON scaffolding every iteration,
+    # so lookup drafts hit constantly. 0 disables.
+    speculative_k: int = 0
+    speculative_ngram: int = 2
     page_size: int = 16
     num_pages: int = 2048
     max_pages_per_seq: int = 320   # 5120 tokens: largest bucket + generation
@@ -346,6 +355,40 @@ class Engine:
         )
         self._sample_jit = jax.jit(sample)
 
+        # Speculative decode pipeline (greedy batches, speculative_k > 0):
+        # scan steps sized so the worst case (everything accepted) emits
+        # exactly one decode_block of tokens per dispatch.
+        self._spec_steps = max(
+            1, cfg.decode_block // (cfg.speculative_k + 1)
+        )
+        self._hist = None  # device [B, H] token history for drafting
+        self._ov_hist_zeros = None  # cached all-zeros ov_hist (no overrides)
+
+        def _spec_pipeline(
+            params, carry_tok, carry_at, carry_eos, carry_hist,
+            override, ov_tok, ov_at, ov_hist, alive, budgets, cache, table,
+        ):
+            from .decode_loop import speculative_block_carry
+
+            return speculative_block_carry(
+                params, mc, carry_tok, carry_at, carry_eos, carry_hist,
+                override, ov_tok, ov_at, ov_hist, alive, budgets, cache,
+                table,
+                jnp.int32(self.tokenizer.eos_id),
+                jnp.int32(self.tokenizer.pad_id),
+                n_steps=self._spec_steps,
+                k=cfg.speculative_k,
+                ngram=cfg.speculative_ngram,
+                dtype=dt,
+            )
+
+        self._spec_pipeline_jit = jax.jit(
+            _spec_pipeline,
+            donate_argnames=(
+                "cache", "carry_tok", "carry_at", "carry_eos", "carry_hist"
+            ),
+        )
+
         # -- pipelined decode state (see step_block) -------------------------
         B = cfg.max_batch_size
         self._lanes: list[int | None] = [None] * B   # lane -> seq_id
@@ -414,7 +457,19 @@ class Engine:
                     self.cache, dropB, zf, zi, of,
                     greedy=greedy,
                 )
+            if self.cfg.speculative_k > 0:
+                H = self.cfg.max_pages_per_seq * self.cfg.page_size
+                zh = jnp.zeros((B, H), jnp.int32)
+                toks, _, self.cache, _ = self._spec_pipeline_jit(
+                    self.params,
+                    jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), bool), zh,
+                    jnp.zeros((B,), bool), zi, zi,
+                    jnp.zeros((B, H), jnp.int32), inactive, zi,
+                    self.cache, dropB,
+                )
             self._carry = None  # warmup carries are throwaways
+            self._hist = None
             # A real device->host pull: on async backends block_until_ready
             # returns immediately, and the point of warmup is that the
             # FIRST request finds an idle, fully-compiled device.
@@ -646,16 +701,18 @@ class Engine:
         self._lanes = [None] * self.cfg.max_batch_size
         self._lane_of.clear()
         self._carry = None
+        self._hist = None
 
     def _pull_oldest(self) -> dict[int, list[int]]:
         """Pull the oldest in-flight block's tokens (the one device->host
         round trip per dispatch) and fold them into host state. Records are
         pulled FIFO, so the host always sees a row's EOS before any of its
         later pad-only blocks."""
-        toks_d, lane_seqs, budgets = self._inflight.popleft()
+        toks_d, lane_seqs, budgets, counts_d = self._inflight.popleft()
         perf = get_perf_stats()
         t0 = time.perf_counter()
         toks = np.asarray(toks_d)
+        counts = None if counts_d is None else np.asarray(counts_d)
         perf.record_metric(
             "engine.block_pull", (time.perf_counter() - t0) * 1e3, "ms"
         )
@@ -675,10 +732,22 @@ class Engine:
                 continue  # finished/vanished while this block was in flight
             n0 = len(s.tokens)
             try:
-                for j in range(int(budgets[lane])):
-                    self._accept_token(s, int(toks[lane, j]))
-                    if s.done:
-                        break
+                if counts is None:
+                    for j in range(int(budgets[lane])):
+                        self._accept_token(s, int(toks[lane, j]))
+                        if s.done:
+                            break
+                else:
+                    # Speculative block: toks is [B, n_steps, k+1] with an
+                    # explicit accepted count per scan step (pads within a
+                    # step are rejection holes, not end-of-output).
+                    for st in range(counts.shape[1]):
+                        for j in range(int(counts[lane, st])):
+                            self._accept_token(s, int(toks[lane, st, j]))
+                            if s.done:
+                                break
+                        if s.done:
+                            break
             except Exception as e:  # noqa: BLE001 - raising stream callback
                 if first_exc is None:
                     first_exc = e
@@ -696,6 +765,21 @@ class Engine:
                     # ones, so reuse is safe without draining.
                     self.alloc.truncate(sid, self._host_written(s))
                     self._free_lane(sid)
+                elif counts is not None:
+                    # Speculative rows emit <= their booking (draft misses),
+                    # so unspent booking would drift the allocator length
+                    # ahead of content without bound, truncating long
+                    # generations early. Roll back to what in-flight
+                    # dispatches can still touch: written content + their
+                    # bookings + the draft-overhang slack (k+1 positions a
+                    # verify step writes past its accepted count).
+                    keep = (
+                        self._host_written(s)
+                        + self._inflight_steps.get(sid, 0)
+                        + self.cfg.speculative_k + 1
+                    )
+                    if self.alloc.length(sid) > keep:
+                        self.alloc.truncate(sid, keep)
         perf.record_metric("engine.decode_tokens", produced, "tok")
         if first_exc is not None:
             raise first_exc
@@ -975,30 +1059,74 @@ class Engine:
             c_tok, c_at, c_eos, c_key = self._carry
             perf = get_perf_stats()
             t_disp = time.perf_counter()
+            speculate = self.cfg.speculative_k > 0 and greedy
+            counts = None
+            if speculate:
+                # Host history for newly seated lanes, prepared OUTSIDE the
+                # dispatch timing block. Drafting is advisory (a stale row
+                # only costs draft quality), and the common all-False
+                # override case reuses one cached device-resident zeros
+                # array instead of transferring B x H zeros per block.
+                H = self.cfg.max_pages_per_seq * self.cfg.page_size
+                if self._hist is None:
+                    self._hist = jnp.zeros((B, H), jnp.int32)
+                if override.any():
+                    ov_hist = np.zeros((B, H), np.int32)
+                    for lane, flag in enumerate(override):
+                        if not flag:
+                            continue
+                        s = self.sequences.get(self._lanes[lane])
+                        if s is None:
+                            continue
+                        ids_h = (s.prompt_ids + s.tokens)[:H]
+                        ov_hist[lane, : len(ids_h)] = ids_h
+                    ov_hist_dev = jnp.asarray(ov_hist)
+                else:
+                    if self._ov_hist_zeros is None:
+                        self._ov_hist_zeros = jnp.zeros((B, H), jnp.int32)
+                    ov_hist_dev = self._ov_hist_zeros
             dev_out: list = []
             with annotate("engine.decode_block"), \
                     device_timer("decode_block", dev_out), self.mesh:
-                toks, self.cache, self._carry = self._decode_pipeline_jit(
-                    self.params,
-                    c_tok, c_at, c_eos, c_key,
-                    jnp.asarray(override),
-                    jnp.asarray(ov_tok),
-                    jnp.asarray(ov_at),
-                    jnp.asarray(alive),
-                    jnp.asarray(budgets),
-                    self.cache,
-                    jnp.asarray(table),
-                    jnp.asarray(temps),
-                    jnp.asarray(top_k),
-                    jnp.asarray(top_p),
-                    greedy=greedy,
-                )
+                if speculate:
+                    toks, counts, self.cache, carry = (
+                        self._spec_pipeline_jit(
+                            self.params,
+                            c_tok, c_at, c_eos, self._hist,
+                            jnp.asarray(override),
+                            jnp.asarray(ov_tok),
+                            jnp.asarray(ov_at),
+                            ov_hist_dev,
+                            jnp.asarray(alive),
+                            jnp.asarray(budgets),
+                            self.cache,
+                            jnp.asarray(table),
+                        )
+                    )
+                    n_tok, n_at, n_eos, self._hist = carry
+                    self._carry = (n_tok, n_at, n_eos, c_key)
+                else:
+                    toks, self.cache, self._carry = self._decode_pipeline_jit(
+                        self.params,
+                        c_tok, c_at, c_eos, c_key,
+                        jnp.asarray(override),
+                        jnp.asarray(ov_tok),
+                        jnp.asarray(ov_at),
+                        jnp.asarray(alive),
+                        jnp.asarray(budgets),
+                        self.cache,
+                        jnp.asarray(table),
+                        jnp.asarray(temps),
+                        jnp.asarray(top_k),
+                        jnp.asarray(top_p),
+                        greedy=greedy,
+                    )
                 dev_out.append(toks)
             perf.record_metric(
                 "engine.block_dispatch", (time.perf_counter() - t_disp) * 1e3,
                 "ms",
             )
-            self._inflight.append((toks, lane_seqs, budgets))
+            self._inflight.append((toks, lane_seqs, budgets, counts))
             for sid, b in zip(lane_seqs, budgets):
                 if sid is not None and b:
                     self._inflight_steps[sid] = (

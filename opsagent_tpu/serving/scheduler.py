@@ -251,7 +251,9 @@ class Scheduler:
             except Exception:  # noqa: BLE001 - device state may be gone
                 pass
             req.generated_prefix = req.generated_prefix + partial
-            budget = req.sampling.max_tokens - len(req.generated_prefix)
+            # sampling.max_tokens was already reduced by earlier restarts'
+            # salvage; subtract only THIS restart's.
+            budget = req.sampling.max_tokens - len(partial)
             if budget <= 0:
                 req.tokens = req.generated_prefix
                 req.finish_reason = "length"
@@ -282,6 +284,17 @@ class Scheduler:
             req.enqueued_s = time.perf_counter()
         # Oldest first so re-admitted work keeps its queue position.
         self._waiting = salvaged + self._waiting
+        # Release the dead engine's device buffers BEFORE building the
+        # replacement: params + KV cache can be most of HBM, and a wedged
+        # (not torn-down) runtime would otherwise hold both engines at
+        # once and OOM the rebuild. The old engine stays referenced for
+        # its host-side state (allocator, sequences) in case the rebuild
+        # fails — admission is host-only, so queued work survives.
+        for buf in ("params", "cache", "_carry", "_hist"):
+            try:
+                setattr(self.engine, buf, None)
+            except Exception:  # noqa: BLE001
+                pass
         try:
             self.engine = self._engine_factory()
         except Exception:  # noqa: BLE001 - slice may still be restarting

@@ -1,0 +1,142 @@
+"""Speculative decoding (prompt-lookup / n-gram drafting): exactness and
+behavior. Greedy speculation is EXACT — drafts are only accepted where
+they equal the model's own argmax — so the speculative engine must emit
+bit-identical token streams to the vanilla engine, just in fewer
+weight-streaming passes. (No reference counterpart: this is serving-engine
+capability the reference outsourced to api.openai.com.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.serving.decode_loop import ngram_draft
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.sampler import SamplingParams
+
+BASE = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+    num_pages=512, max_pages_per_seq=32, max_batch_size=4,
+    prefill_buckets=(16,),
+)
+
+
+def test_ngram_draft_finds_last_match():
+    # history: 5 6 1 2 9 9 7 1 2 | next tok completes gram (1, 2)
+    hist = np.zeros((1, 32), np.int32)
+    hist[0, :9] = [5, 6, 1, 2, 9, 9, 7, 1, 2]
+    # written = 8 tokens (indices 0..7), tok = 2 -> trailing gram (1, 2)
+    # wait: at=8 means hist[:8] = 5 6 1 2 9 9 7 1 written, tok=2.
+    d = ngram_draft(
+        jnp.asarray(hist), jnp.asarray([8]), jnp.asarray([2]), k=3, ngram=2
+    )
+    # LAST full (1,2)-match with 3 written followers is at j=2 -> draft 9 9 7
+    assert list(np.asarray(d)[0]) == [9, 9, 7]
+
+
+def test_ngram_draft_no_match_is_sentinel():
+    hist = np.zeros((1, 16), np.int32)
+    hist[0, :4] = [1, 2, 3, 4]
+    d = ngram_draft(
+        jnp.asarray(hist), jnp.asarray([4]), jnp.asarray([9]), k=2, ngram=2
+    )
+    assert (np.asarray(d) == -1).all()
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_speculative_matches_vanilla_greedy(k):
+    prompts = [
+        [1, 2, 3, 4, 5, 6, 7, 8],
+        [9, 8, 7],
+        [4, 4, 4, 4, 4, 4],
+    ]
+    sampling = SamplingParams(temperature=0.0, max_tokens=24)
+    want = Engine(EngineConfig(**BASE)).generate(prompts, sampling)
+    got = Engine(
+        EngineConfig(speculative_k=k, **BASE)
+    ).generate(prompts, sampling)
+    assert got == want, (got, want)
+
+
+def test_speculative_accepts_repetitive_continuations():
+    """A prompt whose greedy continuation loops (tiny random models settle
+    into cycles) must show multi-token acceptance: fewer verify forwards
+    than emitted tokens."""
+    eng = Engine(EngineConfig(speculative_k=4, **BASE))
+    sampling = SamplingParams(temperature=0.0, max_tokens=48)
+    out = eng.generate([[1, 2, 3, 4, 1, 2, 3, 4]], sampling)[0]
+    assert len(out) == 48 or (
+        eng.tokenizer.eos_id in out
+    )
+
+
+def test_speculative_respects_eos_and_budget():
+    eng = Engine(EngineConfig(speculative_k=3, **BASE))
+    sampling = SamplingParams(temperature=0.0, max_tokens=5)
+    out = eng.generate([[1, 2, 3]], sampling)[0]
+    vanilla = Engine(EngineConfig(**BASE)).generate(
+        [[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=5)
+    )[0]
+    assert out == vanilla
+    assert len(out) <= 5
+
+
+def test_speculative_with_sampled_rows_falls_back():
+    """A batch containing a temperature>0 row uses the vanilla pipeline
+    (speculation is greedy-exact only) — and still completes."""
+    eng = Engine(EngineConfig(speculative_k=4, **BASE))
+    a = eng.add_request([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=6))
+    b = eng.add_request(
+        [4, 5, 6], SamplingParams(temperature=0.8, max_tokens=6)
+    )
+    pending = {a, b}
+    while pending:
+        eng.step_block(sorted(pending))
+        pending = {i for i in pending if not eng.sequences[i].done}
+    ta, tb = eng.finish(a), eng.finish(b)
+    assert len(ta) >= 1 and len(tb) >= 1
+
+
+def test_speculative_streaming_and_prefix_cache():
+    """Spec engine composes with streaming callbacks and prefix caching:
+    a second request sharing the prompt prefix hits cached pages and
+    still produces the exact vanilla stream."""
+    want = Engine(EngineConfig(**BASE)).generate(
+        [[7, 6, 5, 4, 3, 2]], SamplingParams(temperature=0.0, max_tokens=12)
+    )[0]
+    eng = Engine(EngineConfig(speculative_k=3, **BASE))
+    seen: list[int] = []
+    sid = eng.add_request(
+        [7, 6, 5, 4, 3, 2],
+        SamplingParams(temperature=0.0, max_tokens=12),
+        stream=seen.append,
+    )
+    while not eng.sequences[sid].done:
+        eng.step_block([sid])
+    got = eng.finish(sid)
+    assert got == want
+    assert seen == got
+    got2 = eng.generate(
+        [[7, 6, 5, 4, 3, 2]], SamplingParams(temperature=0.0, max_tokens=12)
+    )[0]
+    assert got2 == want
+
+
+def test_speculative_booking_drift_does_not_truncate():
+    """Regression: unspent speculative bookings (draft misses) must be
+    rolled back on pull — with a tight per-seq page cap, drift would
+    otherwise hit max_pages_per_seq and truncate the response early."""
+    cfg = dict(BASE)
+    # Room for prompt(8) + 64 generated + draft slack, and not much more.
+    cfg["max_pages_per_seq"] = 12  # 96 tokens at page_size=8
+    sampling = SamplingParams(temperature=0.0, max_tokens=64)
+    want = Engine(EngineConfig(**cfg)).generate(
+        [[3, 1, 4, 1, 5, 9, 2, 6]], sampling
+    )[0]
+    got = Engine(EngineConfig(speculative_k=3, **cfg)).generate(
+        [[3, 1, 4, 1, 5, 9, 2, 6]], sampling
+    )[0]
+    assert got == want
+    assert len(got) == 64 or got[-1] == Engine(
+        EngineConfig(**cfg)
+    ).tokenizer.eos_id
