@@ -106,6 +106,10 @@ class EngineConfig:
     # so lookup drafts hit constantly. 0 disables.
     speculative_k: int = 0
     speculative_ngram: int = 2
+    # Max admitting sequences prefilled per batched dispatch (scheduler
+    # groups same-bucket chunks; rows pad to powers of two). Divides
+    # per-session TTFT under concurrent admissions by up to this factor.
+    prefill_batch: int = 4
     page_size: int = 16
     num_pages: int = 2048
     max_pages_per_seq: int = 320   # 5120 tokens: largest bucket + generation
@@ -434,6 +438,25 @@ class Engine:
                     self.params, toks, jnp.asarray([0], jnp.int32), ln,
                     self.cache, drop1,
                 )
+                # Batched-admission variants: every power of two up to the
+                # PADDED ceiling (prefill_batch=6 pads to 8 at runtime), and
+                # the sampler at the same widths (several same-bucket rows
+                # can finish in one dispatch).
+                ceil = 1
+                while ceil < self.cfg.prefill_batch:
+                    ceil *= 2
+                bp = 2
+                while bp <= ceil:
+                    lg, self.cache = self._prefill_prefix_jit(
+                        self.params,
+                        jnp.zeros((bp, bucket), jnp.int32),
+                        jnp.zeros((bp,), jnp.int32),
+                        jnp.zeros((bp,), jnp.int32),
+                        self.cache,
+                        jnp.full((bp, MaxP), -1, jnp.int32),
+                    )
+                    self._sample_one(lg, [])
+                    bp *= 2
             self._sample_one(logits, [])
             dropB = jnp.full((B, MaxP), -1, jnp.int32)
             zi = jnp.zeros((B,), jnp.int32)
@@ -545,6 +568,136 @@ class Engine:
                     "engine.prefix_hit_tokens", matched, "tok"
                 )
             return seq_id
+
+    def next_prefill_bucket(self, seq_id: int) -> int:
+        """Bucket the given admitting sequence's NEXT chunk compiles into —
+        the scheduler's grouping key for batched admission."""
+        with self.lock:
+            seq = self.sequences[seq_id]
+            done = self._prefilling[seq_id]
+            return self._bucket(
+                min(seq.prompt_len - done, self.cfg.prefill_buckets[-1])
+            )
+
+    def prefill_batch(self, seq_ids: list[int]) -> dict[int, bool]:
+        """Run ONE prefill chunk for EACH given admitting sequence in a
+        single batched dispatch (same-bucket grouping is the caller's job;
+        smaller chunks ride as padding). Under concurrent admissions
+        (BASELINE config 5) this divides per-session TTFT by the batch
+        width instead of prefilling one session per scheduler tick.
+
+        Rows are padded to a power-of-two batch so XLA compiles a handful
+        of (batch, bucket) variants, with padding rows writing through
+        dropped (-1) page tables. Every row runs the prefix-attention
+        program (start = tokens already prefilled; 0 for fresh prompts —
+        same math, one code path batches mixed admission states).
+
+        Returns {seq_id: fully_prefilled | Exception}: row-local failures
+        (a raising stream callback or mask_fn on the first token) clean up
+        and fail ONLY their own row — per-request isolation matches the
+        decode path's one-bad-apple contract. A failed DISPATCH cleans up
+        every batched sequence (pages freed, Sequence dropped) before the
+        exception propagates."""
+        with self.lock:
+            try:
+                seqs = [self.sequences[s] for s in seq_ids]
+                dones = [self._prefilling[s] for s in seq_ids]
+                chunks = [
+                    min(seq.prompt_len - d, self.cfg.prefill_buckets[-1])
+                    for seq, d in zip(seqs, dones)
+                ]
+                bucket = self._bucket(max(chunks))
+                Bp = 1
+                while Bp < len(seq_ids):
+                    Bp *= 2
+                tokens = np.full(
+                    (Bp, bucket), self.tokenizer.pad_id, np.int32
+                )
+                starts = np.zeros((Bp,), np.int32)
+                lens = np.zeros((Bp,), np.int32)
+                tables = np.full(
+                    (Bp, self.cfg.max_pages_per_seq), -1, np.int32
+                )
+                for i, (sid, seq, d, c) in enumerate(
+                    zip(seq_ids, seqs, dones, chunks)
+                ):
+                    tokens[i, :c] = seq.prompt_ids[d : d + c]
+                    starts[i] = d
+                    lens[i] = c
+                    tables[i] = self.alloc.page_table_row(sid)
+                dev_out: list = []
+                with annotate("engine.prefill_chunk"), \
+                        device_timer("prefill_chunk", dev_out), self.mesh:
+                    logits, self.cache = self._prefill_prefix_jit(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray(starts),
+                        jnp.asarray(lens),
+                        self.cache,
+                        jnp.asarray(tables),
+                    )
+                    dev_out.append(logits)
+                perf = get_perf_stats()
+                perf.record_metric(
+                    "engine.prefill_tokens", int(sum(chunks)), "tok"
+                )
+                out: dict[int, Any] = {}
+                finished_rows = [
+                    i for i, (seq, d, c) in enumerate(zip(seqs, dones, chunks))
+                    if d + c >= seq.prompt_len
+                ]
+                # Pre-screen constrained rows: a raising mask_fn must fail
+                # only its own row, and _sample_one batches every finished
+                # row's masks in one call (mask_fns are pure, so the
+                # screening call duplicates no state).
+                bad: dict[int, Exception] = {}
+                for i in finished_rows:
+                    if seqs[i].mask_fn is None:
+                        continue
+                    try:
+                        seqs[i].mask_fn(seqs[i].tokens)
+                    except Exception as e:  # noqa: BLE001
+                        bad[i] = e
+                finished_rows = [i for i in finished_rows if i not in bad]
+                first_toks = None
+                if finished_rows:
+                    first_toks = self._sample_one(
+                        logits[jnp.asarray(finished_rows)],
+                        [seqs[i] for i in finished_rows],
+                    )
+                for i, (sid, seq, d, c) in enumerate(
+                    zip(seq_ids, seqs, dones, chunks)
+                ):
+                    if i in bad:
+                        self._drop_admission(sid)
+                        out[sid] = bad[i]
+                        continue
+                    if d + c < seq.prompt_len:
+                        self._prefilling[sid] = d + c
+                        out[sid] = False
+                        continue
+                    del self._prefilling[sid]
+                    token = int(first_toks[finished_rows.index(i)])
+                    seq.ttft_s = time.perf_counter() - seq.started_s
+                    perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
+                    try:
+                        self._accept_token(seq, token)
+                    except Exception as e:  # noqa: BLE001 - stream callback
+                        self._drop_admission(sid)
+                        out[sid] = e
+                        continue
+                    out[sid] = True
+                return out
+            except Exception:
+                for sid in seq_ids:
+                    self._drop_admission(sid)
+                raise
+
+    def _drop_admission(self, seq_id: int) -> None:
+        """Clean one failed admission: pages freed, host state dropped."""
+        self.sequences.pop(seq_id, None)
+        self._prefilling.pop(seq_id, None)
+        self.alloc.free(seq_id)
 
     def prefill_step(self, seq_id: int) -> bool:
         """Stage 2 of admission: run ONE bucket-sized prefill chunk,

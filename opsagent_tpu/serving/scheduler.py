@@ -186,23 +186,48 @@ class Scheduler:
         self._waiting = still
 
     def _advance_prefill(self) -> None:
-        """Run ONE prefill chunk for the oldest admitting request. One
-        chunk per tick means a 4096-token prompt interleaves ~bucket-sized
-        slices of prefill with decode blocks instead of monopolizing the
-        device for the whole admission."""
+        """Run ONE prefill chunk for a BATCH of admitting requests: the
+        oldest one plus up to prefill_batch-1 more whose next chunk
+        compiles into the same bucket, in a single dispatch
+        (engine.prefill_batch). One chunk-batch per tick means long
+        prompts interleave with decode blocks instead of monopolizing the
+        device, while concurrent admissions (BASELINE config 5) share
+        dispatches instead of queueing one per tick."""
         if not self._prefilling:
             return
-        sid = next(iter(self._prefilling))
-        req = self._prefilling[sid]
+        first = next(iter(self._prefilling))
+        batch = [first]
         try:
-            if self.engine.prefill_step(sid):
-                self._running[sid] = self._prefilling.pop(sid)
+            bucket = self.engine.next_prefill_bucket(first)
+            for sid in self._prefilling:
+                if len(batch) >= self.engine.cfg.prefill_batch:
+                    break
+                if sid != first and (
+                    self.engine.next_prefill_bucket(sid) == bucket
+                ):
+                    batch.append(sid)
+            results = self.engine.prefill_batch(batch)
         except Exception as e:  # noqa: BLE001 - engine cleaned up already
-            self._prefilling.pop(sid, None)
-            req.error = f"admission failed: {e}"
-            if isinstance(e, (InvalidRequest, PromptTooLong)):
-                req.error_status = 400
-            req.done.set()
+            for sid in batch:
+                self._fail_admission(sid, e)
+            return
+        for sid, res in results.items():
+            if isinstance(res, Exception):
+                # Row-local failure (raising stream callback / mask_fn):
+                # only this request fails, matching the decode path's
+                # one-bad-apple isolation.
+                self._fail_admission(sid, res)
+            elif res:
+                self._running[sid] = self._prefilling.pop(sid)
+
+    def _fail_admission(self, sid: int, e: Exception) -> None:
+        req = self._prefilling.pop(sid, None)
+        if req is None:
+            return
+        req.error = f"admission failed: {e}"
+        if isinstance(e, (InvalidRequest, PromptTooLong)):
+            req.error_status = 400
+        req.done.set()
 
     def _reap(self) -> None:
         finished = [

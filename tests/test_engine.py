@@ -397,3 +397,82 @@ def test_scheduler_long_admission_keeps_decodes_flowing():
         assert r2.tokens == want_long
     finally:
         sched.stop()
+
+
+def test_batched_prefill_matches_sequential():
+    """engine.prefill_batch (one dispatch for several admitting sequences,
+    mixed fresh/partial states as prefix rows) must produce the same first
+    tokens and generations as chunk-at-a-time prefill_step admission."""
+    import jax.numpy as jnp
+
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    kw = dict(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+        num_pages=256, max_pages_per_seq=32, max_batch_size=4,
+        prefill_buckets=(8, 16),
+    )
+    prompts = [
+        list(range(1, 13)),          # 12 tokens: chunks under bucket 16
+        [7, 7, 8, 9],                # short fresh prompt
+        list(range(20, 44)),         # 24 tokens: multiple chunks
+    ]
+    sampling = SamplingParams(temperature=0.0, max_tokens=6)
+
+    want_eng = Engine(EngineConfig(**kw))
+    want = want_eng.generate(prompts, sampling)
+
+    eng = Engine(EngineConfig(**kw))
+    sids = [eng.begin_request(p, sampling) for p in prompts]
+    pending = set(sids)
+    while pending:
+        # Group is the caller's job; batch everything sharing the first
+        # sequence's bucket, chunk the rest alone.
+        first = sorted(pending)[0]
+        bucket = eng.next_prefill_bucket(first)
+        batch = [
+            s for s in sorted(pending)
+            if eng.next_prefill_bucket(s) == bucket
+        ][: eng.cfg.prefill_batch]
+        res = eng.prefill_batch(batch)
+        pending -= {s for s, done in res.items() if done is True}
+    live = {s for s in sids if not eng.sequences[s].done}
+    while live:
+        eng.step_block(sorted(live))
+        live = {s for s in live if not eng.sequences[s].done}
+    got = [eng.finish(s) for s in sids]
+    assert got == want, (got, want)
+
+
+def test_batched_prefill_isolates_bad_row():
+    """A raising stream callback in one batched admission must fail ONLY
+    that row: the other sequences keep their pages and first tokens."""
+    import jax.numpy as jnp
+
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    eng = Engine(EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+        num_pages=256, max_pages_per_seq=32, max_batch_size=4,
+        prefill_buckets=(16,),
+    ))
+    free0 = eng.alloc.free_pages
+    sampling = SamplingParams(temperature=0.0, max_tokens=4)
+
+    def boom(_tok):
+        raise RuntimeError("client went away")
+
+    good = eng.begin_request([1, 2, 3, 4], sampling)
+    bad = eng.begin_request([5, 6, 7], sampling, stream=boom)
+    res = eng.prefill_batch([good, bad])
+    assert res[good] is True
+    assert isinstance(res[bad], RuntimeError)
+    assert bad not in eng.sequences  # cleaned up
+    assert len(eng.sequences[good].tokens) == 1  # first token sampled
+    # Page accounting: only the good sequence holds pages now.
+    while not eng.sequences[good].done:
+        eng.step_block([good])
+    eng.finish(good)
+    assert eng.alloc.free_pages == free0
