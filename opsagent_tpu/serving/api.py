@@ -109,6 +109,12 @@ class ServingStack:
         raise ValueError(f"unsupported response_format type {kind!r}")
 
     def _sampling_from(self, body: dict[str, Any]) -> SamplingParams:
+        logprobs = bool(body.get("logprobs", False))
+        top_lp = int(body.get("top_logprobs", 0) or 0)
+        if top_lp and not logprobs:
+            raise ValueError("top_logprobs requires logprobs: true")
+        if not 0 <= top_lp <= 20:
+            raise ValueError("top_logprobs must be in 0..20")
         return SamplingParams(
             temperature=float(body.get("temperature", 0.0) or 0.0),
             top_k=int(body.get("top_k", 0) or 0),
@@ -120,6 +126,8 @@ class ServingStack:
                 [body["stop"]] if isinstance(body.get("stop"), str)
                 else body.get("stop") or []
             ),
+            logprobs=logprobs,
+            top_logprobs=top_lp,
         )
 
     def _prompt_ids(self, body: dict[str, Any]) -> list[int]:
@@ -191,14 +199,45 @@ class ServingStack:
         if tool_calls:
             message = {"role": "assistant", "content": None, "tool_calls": tool_calls}
             finish = "tool_calls"
+        choice: dict[str, Any] = {
+            "index": 0, "message": message, "finish_reason": finish,
+        }
+        if sampling.logprobs:
+            tok = self.engine.tokenizer
+            lp_toks = (
+                tokens[:-1] if tokens and tokens[-1] == tok.eos_id else tokens
+            )
+            if finish == "stop" and sampling.stop:
+                # logprobs.content must align with the (stop-truncated)
+                # message content: drop entries from the token that
+                # completes the first stop match onward.
+                for n in range(1, len(lp_toks) + 1):
+                    txt = tok.decode(lp_toks[:n])
+                    if any(s in txt for s in sampling.stop):
+                        lp_toks = lp_toks[: n - 1]
+                        break
+            choice["logprobs"] = {
+                "content": [
+                    {
+                        # token_str, not decode([t]): decode skips special
+                        # tokens (eos would render "") and mangles tokens
+                        # that are half of a multi-byte character.
+                        "token": tok.token_str(t),
+                        "logprob": d["logprob"],
+                        "top_logprobs": [
+                            {"token": tok.token_str(i), "logprob": l}
+                            for i, l in d["top"]
+                        ],
+                    }
+                    for t, d in zip(lp_toks, req.logprob_data)
+                ]
+            }
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
             "created": int(t0),
             "model": body.get("model") or self.model_name,
-            "choices": [
-                {"index": 0, "message": message, "finish_reason": finish}
-            ],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": len(prompt_ids),
                 "completion_tokens": len(tokens),
@@ -209,6 +248,12 @@ class ServingStack:
     def chat_completion_stream(self, body: dict[str, Any]):
         """Generator of SSE chunk dicts (sync; drive from a thread)."""
         sampling, prompt_ids, mask_fn = self._translate(body)
+        if sampling.logprobs:
+            # Refuse rather than silently dropping the field (and paying
+            # the engine's host-stepped logprob path for nothing).
+            raise RequestError(
+                "logprobs are not supported with stream: true", 400
+            )
         token_q: "queue.Queue[int | None]" = queue.Queue()
         req = Request(
             prompt_ids, sampling, mask_fn=mask_fn, on_token=lambda t: token_q.put(t)

@@ -154,6 +154,9 @@ class Sequence:
     stream: Callable[[int], None] | None = None
     ttft_s: float = 0.0
     started_s: float = field(default_factory=time.perf_counter)
+    # Per generated token, when params.logprobs: {"logprob": float,
+    # "top": [(token_id, logprob), ...][:params.top_logprobs]}.
+    logprob_data: list[dict] = field(default_factory=list)
 
 
 class Engine:
@@ -325,6 +328,34 @@ class Engine:
             tok = sample(logits, key, temps, top_k, top_p, mask)
             return tok.astype(jnp.int32), cache
 
+        def _decode_sample_lp(
+            params, tokens, lengths, cache, table, active,
+            key, temps, top_k, top_p, mask,
+        ):
+            """Fused decode+sample that ALSO returns the sampled token's
+            logprob and the top-20 alternatives (the OpenAI logprobs API
+            caps top_logprobs at 20; a fixed width keeps the shape
+            static). Used for rows whose request asked for logprobs."""
+            logits, cache = llama.decode_step(
+                params, mc, tokens, lengths, cache, table, active, dtype=dt,
+                attn_impl=self.attn_impl, mesh=self.mesh,
+            )
+            tok = sample(logits, key, temps, top_k, top_p, mask)
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            chosen = jnp.take_along_axis(
+                lp, tok[:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            # Padded embedding vocab (e.g. Qwen): padded ids carry
+            # arbitrary untrained logits and the tokenizer cannot render
+            # them — keep them out of the top-20 alternatives.
+            tv = min(self.tokenizer.vocab_size, mc.vocab_size)
+            if tv < mc.vocab_size:
+                lp = jnp.where(
+                    jnp.arange(mc.vocab_size)[None, :] < tv, lp, -jnp.inf
+                )
+            tl, ti = jax.lax.top_k(lp, 20)
+            return tok.astype(jnp.int32), chosen, ti.astype(jnp.int32), tl, cache
+
         def _decode_pipeline(
             params, carry_tok, carry_at, carry_eos, key,
             override, ov_tok, ov_at, alive, budgets, cache, table,
@@ -351,6 +382,9 @@ class Engine:
         )
         self._decode_sample_jit = jax.jit(
             _decode_sample, donate_argnames=("cache",)
+        )
+        self._decode_sample_lp_jit = jax.jit(
+            _decode_sample_lp, donate_argnames=("cache",)
         )
         self._decode_pipeline_jit = jax.jit(
             _decode_pipeline,
@@ -803,7 +837,28 @@ class Engine:
             jnp.asarray(top_p),
             None if mask is None else jnp.asarray(mask),
         )
-        return np.asarray(tok)
+        toks = np.asarray(tok)
+        if any(s is not None and s.params.logprobs for s in seqs):
+            # First-token logprobs (prefill's sampled token), host-side:
+            # admission is not the steady-state hot loop.
+            lg = np.asarray(
+                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            )
+            tv = min(self.tokenizer.vocab_size, lg.shape[1])
+            for i, s in enumerate(seqs):
+                if s is None or not s.params.logprobs:
+                    continue
+                row, n = lg[i].copy(), s.params.top_logprobs
+                row[tv:] = -np.inf  # padded-vocab ids: unrenderable
+                top = []
+                if n > 0:
+                    idx = np.argpartition(-row, n)[:n]
+                    idx = idx[np.argsort(-row[idx])]
+                    top = [(int(j), float(row[j])) for j in idx]
+                s.logprob_data.append(
+                    {"logprob": float(row[int(toks[i])]), "top": top}
+                )
+        return toks
 
     def _accept_token(self, seq: Sequence, token: int) -> None:
         seq.tokens.append(token)
@@ -1013,8 +1068,10 @@ class Engine:
             slots = running + [None] * (B - len(running))
             temps, top_k, top_p, mask = self._sampling_arrays(slots, B)
             self._sample_key, sub = jax.random.split(self._sample_key)
+            want_lp = any(s.params.logprobs for s in running)
+            chosen_lp = top_ids = top_lps = None
             with self.mesh:
-                sampled, self.cache = self._decode_sample_jit(
+                args = (
                     self.params,
                     jnp.asarray(tokens),
                     jnp.asarray(write_at),
@@ -1027,11 +1084,29 @@ class Engine:
                     jnp.asarray(top_p),
                     None if mask is None else jnp.asarray(mask),
                 )
+                if want_lp:
+                    sampled, chosen_lp, top_ids, top_lps, self.cache = (
+                        self._decode_sample_lp_jit(*args)
+                    )
+                    chosen_lp = np.asarray(chosen_lp)
+                    top_ids = np.asarray(top_ids)
+                    top_lps = np.asarray(top_lps)
+                else:
+                    sampled, self.cache = self._decode_sample_jit(*args)
             sampled = np.asarray(sampled)
             out: dict[int, int] = {}
             first_exc: BaseException | None = None
             for i, s in enumerate(running):
                 tok = int(sampled[i])
+                if s.params.logprobs:
+                    n = s.params.top_logprobs
+                    s.logprob_data.append({
+                        "logprob": float(chosen_lp[i]),
+                        "top": [
+                            (int(top_ids[i, j]), float(top_lps[i, j]))
+                            for j in range(min(n, top_ids.shape[1]))
+                        ],
+                    })
                 try:
                     self._accept_token(s, tok)
                 except Exception as e:  # noqa: BLE001 - raising stream cb
@@ -1073,8 +1148,14 @@ class Engine:
             ]
             running = running[: self.cfg.max_batch_size]
             block = self.cfg.decode_block
-            masked = [s for s in running if s.mask_fn is not None]
-            plain = [s for s in running if s.mask_fn is None]
+            # Host-stepped rows: constrained masks need a host-computed
+            # logits mask per token; logprob rows need per-token device
+            # pulls the pipelined block does not surface.
+            def hosted(s):
+                return s.mask_fn is not None or s.params.logprobs
+
+            masked = [s for s in running if hosted(s)]
+            plain = [s for s in running if not hosted(s)]
             if running and (block <= 1 or (masked and not plain)):
                 return {
                     sid: [tok]

@@ -23,6 +23,10 @@ class SamplingParams:
     top_p: float = 1.0      # 1.0 = disabled
     max_tokens: int = 2048
     stop: tuple[str, ...] = ()
+    # OpenAI logprobs: False = off; True returns each sampled token's
+    # logprob, with top_logprobs (0..20) alternatives per position.
+    logprobs: bool = False
+    top_logprobs: int = 0
 
 
 # Candidate-set size for top-k / top-p sampling. Full-vocab SORTS are the

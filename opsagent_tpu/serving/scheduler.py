@@ -54,6 +54,10 @@ class Request:
     # re-admission folds them into the prompt, and the final result is
     # generated_prefix + the post-restart generation.
     generated_prefix: list[int] = field(default_factory=list)
+    # Per-token logprob entries (engine Sequence.logprob_data), populated
+    # at reap when sampling.logprobs was requested; accumulates across
+    # engine restarts like generated_prefix.
+    logprob_data: list[dict] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         self.done = threading.Event()
@@ -236,7 +240,9 @@ class Scheduler:
         ]
         for sid in finished:
             req = self._running.pop(sid)
-            req.finish_reason = self.engine.sequences[sid].finish_reason
+            seq = self.engine.sequences[sid]
+            req.finish_reason = seq.finish_reason
+            req.logprob_data = req.logprob_data + seq.logprob_data
             req.tokens = req.generated_prefix + self.engine.finish(sid)
             if req.finish_reason == "error":
                 # The engine terminated this sequence on a raising stream
@@ -271,10 +277,18 @@ class Scheduler:
         salvaged: list[Request] = []
         for sid, req in list(self._running.items()):
             partial: list[int] = []
+            seq_obj = self.engine.sequences.get(sid)
             try:
                 partial = self.engine.finish(sid)
             except Exception:  # noqa: BLE001 - device state may be gone
                 pass
+            if seq_obj is not None:
+                # Slice to the tokens actually salvaged: if finish()
+                # raised, partial is empty and keeping the entries would
+                # misalign every post-restart token's logprobs.
+                req.logprob_data = (
+                    req.logprob_data + seq_obj.logprob_data[: len(partial)]
+                )
             req.generated_prefix = req.generated_prefix + partial
             # sampling.max_tokens was already reduced by earlier restarts'
             # salvage; subtract only THIS restart's.

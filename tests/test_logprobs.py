@@ -1,0 +1,148 @@
+"""OpenAI logprobs support: per-token logprob of the sampled token plus
+top-N alternatives, end to end (engine -> scheduler -> chat.completions).
+Values are pinned against the full-forward oracle's log_softmax."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.models import llama
+from opsagent_tpu.serving.api import ServingStack
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.sampler import SamplingParams
+
+KW = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+    num_pages=256, max_pages_per_seq=32, max_batch_size=4,
+    prefill_buckets=(16,),
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(EngineConfig(**KW))
+
+
+def test_engine_logprobs_match_oracle(engine):
+    prompt = [257, 5, 6, 7]
+    sid = engine.add_request(
+        prompt,
+        SamplingParams(temperature=0.0, max_tokens=4, logprobs=True,
+                       top_logprobs=3),
+    )
+    while not engine.sequences[sid].done:
+        engine.step_block([sid])
+    seq = engine.sequences[sid]
+    data = list(seq.logprob_data)
+    toks = engine.finish(sid)
+    assert len(data) == len(toks)
+    # Oracle: teacher-forced full forward, log_softmax at each position.
+    ctx = list(prompt)
+    for t, d in zip(toks, data):
+        logits = llama.forward_full(
+            engine.params, engine.model_cfg, jnp.asarray([ctx]),
+            dtype=jnp.float32,
+        )
+        lp = jax.nn.log_softmax(logits[0, -1])
+        assert abs(float(lp[t]) - d["logprob"]) < 1e-3
+        assert len(d["top"]) == 3
+        # Tops are the true argmax set, sorted descending.
+        want_top = np.argsort(-np.asarray(lp))[:3]
+        assert [i for i, _ in d["top"]] == [int(x) for x in want_top]
+        assert d["top"][0][1] >= d["top"][1][1] >= d["top"][2][1]
+        ctx.append(t)
+
+
+def test_logprobs_without_top(engine):
+    sid = engine.add_request(
+        [257, 9], SamplingParams(temperature=0.0, max_tokens=2, logprobs=True),
+    )
+    while not engine.sequences[sid].done:
+        engine.step_block([sid])
+    data = list(engine.sequences[sid].logprob_data)
+    engine.finish(sid)
+    assert all(d["top"] == [] for d in data)
+    assert all(d["logprob"] <= 0.0 for d in data)
+
+
+def test_chat_completion_logprobs_shape():
+    stack = ServingStack(Engine(EngineConfig(**KW)))
+    try:
+        resp = stack.chat_completion({
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 3, "temperature": 0,
+            "logprobs": True, "top_logprobs": 2,
+        })
+        lp = resp["choices"][0]["logprobs"]
+        assert lp is not None and len(lp["content"]) >= 1
+        ent = lp["content"][0]
+        assert isinstance(ent["token"], str)
+        assert ent["logprob"] <= 0.0
+        assert len(ent["top_logprobs"]) == 2
+        assert ent["top_logprobs"][0]["logprob"] >= ent["top_logprobs"][1]["logprob"]
+
+        plain = stack.chat_completion({
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 3, "temperature": 0,
+        })
+        assert "logprobs" not in plain["choices"][0]
+    finally:
+        stack.close()
+
+
+def test_top_logprobs_validation():
+    stack = ServingStack(Engine(EngineConfig(**KW)))
+    try:
+        from opsagent_tpu.serving.scheduler import RequestError
+
+        with pytest.raises(RequestError):
+            stack.chat_completion({
+                "messages": [{"role": "user", "content": "x"}],
+                "top_logprobs": 3,   # without logprobs: true
+            })
+        with pytest.raises(RequestError):
+            stack.chat_completion({
+                "messages": [{"role": "user", "content": "x"}],
+                "logprobs": True, "top_logprobs": 21,
+            })
+    finally:
+        stack.close()
+
+
+def test_logprobs_row_does_not_block_plain_batch(engine):
+    """A logprob row host-steps while plain rows keep block-decoding; both
+    finish with correct results."""
+    want = engine.generate(
+        [[257, 1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=5)
+    )[0]
+    a = engine.add_request(
+        [257, 1, 2, 3], SamplingParams(temperature=0.0, max_tokens=5)
+    )
+    b = engine.add_request(
+        [257, 8, 9],
+        SamplingParams(temperature=0.0, max_tokens=5, logprobs=True),
+    )
+    pending = {a, b}
+    while pending:
+        engine.step_block(sorted(pending))
+        pending = {i for i in pending if not engine.sequences[i].done}
+    lp_len = len(engine.sequences[b].logprob_data)
+    ta, tb = engine.finish(a), engine.finish(b)
+    assert ta == want
+    assert lp_len == len(tb)
+
+
+def test_stream_with_logprobs_rejected():
+    from opsagent_tpu.serving.scheduler import RequestError
+
+    stack = ServingStack(Engine(EngineConfig(**KW)))
+    try:
+        gen = stack.chat_completion_stream({
+            "messages": [{"role": "user", "content": "x"}],
+            "stream": True, "logprobs": True,
+        })
+        with pytest.raises(RequestError, match="stream"):
+            next(gen)
+    finally:
+        stack.close()
