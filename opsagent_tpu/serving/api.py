@@ -115,6 +115,19 @@ class ServingStack:
             raise ValueError("top_logprobs requires logprobs: true")
         if not 0 <= top_lp <= 20:
             raise ValueError("top_logprobs must be in 0..20")
+        lb_raw = body.get("logit_bias") or {}
+        if not isinstance(lb_raw, dict):
+            raise ValueError("logit_bias must be an object of id -> bias")
+        logit_bias = []
+        for k, v in lb_raw.items():
+            b = float(v)
+            if not -100.0 <= b <= 100.0:
+                raise ValueError("logit_bias values must be in -100..100")
+            logit_bias.append((int(k), b))
+        pres = float(body.get("presence_penalty", 0.0) or 0.0)
+        freq = float(body.get("frequency_penalty", 0.0) or 0.0)
+        if not -2.0 <= pres <= 2.0 or not -2.0 <= freq <= 2.0:
+            raise ValueError("penalties must be in -2..2")
         return SamplingParams(
             temperature=float(body.get("temperature", 0.0) or 0.0),
             top_k=int(body.get("top_k", 0) or 0),
@@ -128,6 +141,9 @@ class ServingStack:
             ),
             logprobs=logprobs,
             top_logprobs=top_lp,
+            logit_bias=tuple(logit_bias),
+            presence_penalty=pres,
+            frequency_penalty=freq,
         )
 
     def _prompt_ids(self, body: dict[str, Any]) -> list[int]:
@@ -209,13 +225,23 @@ class ServingStack:
             )
             if finish == "stop" and sampling.stop:
                 # logprobs.content must align with the (stop-truncated)
-                # message content: drop entries from the token that
-                # completes the first stop match onward.
-                for n in range(1, len(lp_toks) + 1):
-                    txt = tok.decode(lp_toks[:n])
-                    if any(s in txt for s in sampling.stop):
-                        lp_toks = lp_toks[: n - 1]
-                        break
+                # message content: _finalize_text cuts the text at the
+                # START of the stop match, so keep only tokens whose
+                # cumulative decode fits before that index. A token the
+                # cut splits mid-way is dropped (conservative: logprobs
+                # are a subset of content, never beyond it).
+                full = tok.decode(lp_toks)
+                hits = [full.find(s) for s in sampling.stop]
+                hits = [h for h in hits if h >= 0]
+                if hits:
+                    cut = min(hits)
+                    keep = 0
+                    for n in range(1, len(lp_toks) + 1):
+                        if len(tok.decode(lp_toks[:n])) <= cut:
+                            keep = n
+                        else:
+                            break
+                    lp_toks = lp_toks[:keep]
             choice["logprobs"] = {
                 "content": [
                     {

@@ -157,6 +157,8 @@ class Sequence:
     # Per generated token, when params.logprobs: {"logprob": float,
     # "top": [(token_id, logprob), ...][:params.top_logprobs]}.
     logprob_data: list[dict] = field(default_factory=list)
+    # Cached static logit_bias row [V] (built on first use).
+    static_bias: Any = None
 
 
 class Engine:
@@ -318,28 +320,36 @@ class Engine:
 
         def _decode_sample(
             params, tokens, lengths, cache, table, active,
-            key, temps, top_k, top_p, mask,
+            key, temps, top_k, top_p, mask, bias=None,
         ):
-            """One fused decode+sample dispatch (one round trip, not two)."""
+            """One fused decode+sample dispatch (one round trip, not two).
+            ``bias`` [B, V] is the additive logit adjustment carrying
+            OpenAI logit_bias and presence/frequency penalties."""
             logits, cache = llama.decode_step(
                 params, mc, tokens, lengths, cache, table, active, dtype=dt,
                 attn_impl=self.attn_impl, mesh=self.mesh,
             )
+            if bias is not None:
+                logits = logits + bias
             tok = sample(logits, key, temps, top_k, top_p, mask)
             return tok.astype(jnp.int32), cache
 
         def _decode_sample_lp(
             params, tokens, lengths, cache, table, active,
-            key, temps, top_k, top_p, mask,
+            key, temps, top_k, top_p, mask, bias=None,
         ):
             """Fused decode+sample that ALSO returns the sampled token's
             logprob and the top-20 alternatives (the OpenAI logprobs API
             caps top_logprobs at 20; a fixed width keeps the shape
-            static). Used for rows whose request asked for logprobs."""
+            static). Used for rows whose request asked for logprobs.
+            Logprobs reflect the post-bias distribution — the one actually
+            sampled from."""
             logits, cache = llama.decode_step(
                 params, mc, tokens, lengths, cache, table, active, dtype=dt,
                 attn_impl=self.attn_impl, mesh=self.mesh,
             )
+            if bias is not None:
+                logits = logits + bias
             tok = sample(logits, key, temps, top_k, top_p, mask)
             lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             chosen = jnp.take_along_axis(
@@ -401,6 +411,7 @@ class Engine:
         )
         self._hist = None  # device [B, H] token history for drafting
         self._ov_hist_zeros = None  # cached all-zeros ov_hist (no overrides)
+        self._bias_buf = None  # reused host [B, V] logit-bias batch buffer
 
         def _spec_pipeline(
             params, carry_tok, carry_at, carry_eos, carry_hist,
@@ -502,6 +513,23 @@ class Engine:
                 self.params, zi, zi, self.cache, dropB, inactive,
                 sub, zf, zi, of, None,
             )
+            # Bias / logprobs variants: the first logit_bias, penalty, or
+            # logprobs request must not pay an XLA compile under the
+            # engine lock.
+            biasB = jnp.zeros(
+                (B, self.model_cfg.vocab_size), jnp.float32
+            )
+            self._sample_key, sub = jax.random.split(self._sample_key)
+            _, self.cache = self._decode_sample_jit(
+                self.params, zi, zi, self.cache, dropB, inactive,
+                sub, zf, zi, of, None, biasB,
+            )
+            for b in (None, biasB):
+                self._sample_key, sub = jax.random.split(self._sample_key)
+                _, _, _, _, self.cache = self._decode_sample_lp_jit(
+                    self.params, zi, zi, self.cache, dropB, inactive,
+                    sub, zf, zi, of, None, b,
+                )
             toks = None
             for greedy in (True, False):
                 # Fresh arrays per call: carry args are donated.
@@ -825,9 +853,60 @@ class Engine:
                 mask[i, n:] = False
         return temps, top_k, top_p, mask
 
+    @staticmethod
+    def _needs_bias(s: Sequence) -> bool:
+        p = s.params
+        return bool(
+            p.logit_bias or p.presence_penalty or p.frequency_penalty
+        )
+
+    def _bias_array(
+        self, seqs: list[Sequence | None], B: int
+    ) -> np.ndarray | None:
+        """Additive [B, V] logit bias, or None when no row needs one:
+        OpenAI logit_bias entries plus presence/frequency penalties over
+        each row's generated-so-far token counts (including tokens
+        generated before an engine restart — params.penalty_history).
+        The static logit_bias row is cached per sequence and the batch
+        buffer reused, so pure-logit_bias rows cost a memcpy per step."""
+        V = self.model_cfg.vocab_size
+        bias = None
+        for i, s in enumerate(seqs):
+            if s is None or not self._needs_bias(s):
+                continue
+            if bias is None:
+                if self._bias_buf is None or self._bias_buf.shape != (B, V):
+                    self._bias_buf = np.zeros((B, V), np.float32)
+                else:
+                    self._bias_buf[:] = 0.0
+                bias = self._bias_buf
+            p = s.params
+            if s.static_bias is None:
+                row = np.zeros((V,), np.float32)
+                for tid, b in p.logit_bias:
+                    if 0 <= tid < V:
+                        row[tid] += b
+                s.static_bias = row
+            bias[i] = s.static_bias
+            if p.presence_penalty or p.frequency_penalty:
+                hist = list(p.penalty_history) + s.tokens
+                if hist:
+                    ids, counts = np.unique(
+                        np.asarray(hist, np.int64), return_counts=True
+                    )
+                    sel = ids < V
+                    bias[i, ids[sel]] -= (
+                        p.presence_penalty
+                        + p.frequency_penalty * counts[sel]
+                    )
+        return bias
+
     def _sample_one(self, logits: jax.Array, seqs: list[Sequence]) -> np.ndarray:
         B = logits.shape[0]
         temps, top_k, top_p, mask = self._sampling_arrays(seqs, B)
+        bias = self._bias_array(seqs, B)
+        if bias is not None:
+            logits = logits + jnp.asarray(bias)
         self._sample_key, sub = jax.random.split(self._sample_key)
         tok = self._sample_jit(
             logits,
@@ -1067,6 +1146,7 @@ class Engine:
                 tokens[i] = s.tokens[-1] if s.tokens else self.tokenizer.bos_id
             slots = running + [None] * (B - len(running))
             temps, top_k, top_p, mask = self._sampling_arrays(slots, B)
+            bias = self._bias_array(slots, B)
             self._sample_key, sub = jax.random.split(self._sample_key)
             want_lp = any(s.params.logprobs for s in running)
             chosen_lp = top_ids = top_lps = None
@@ -1083,6 +1163,7 @@ class Engine:
                     jnp.asarray(top_k),
                     jnp.asarray(top_p),
                     None if mask is None else jnp.asarray(mask),
+                    None if bias is None else jnp.asarray(bias),
                 )
                 if want_lp:
                     sampled, chosen_lp, top_ids, top_lps, self.cache = (
@@ -1150,9 +1231,14 @@ class Engine:
             block = self.cfg.decode_block
             # Host-stepped rows: constrained masks need a host-computed
             # logits mask per token; logprob rows need per-token device
-            # pulls the pipelined block does not surface.
+            # pulls the pipelined block does not surface; biased rows
+            # (logit_bias / penalties) need the bias rebuilt per token.
             def hosted(s):
-                return s.mask_fn is not None or s.params.logprobs
+                return (
+                    s.mask_fn is not None
+                    or s.params.logprobs
+                    or self._needs_bias(s)
+                )
 
             masked = [s for s in running if hosted(s)]
             plain = [s for s in running if not hosted(s)]

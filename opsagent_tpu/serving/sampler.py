@@ -27,6 +27,16 @@ class SamplingParams:
     # logprob, with top_logprobs (0..20) alternatives per position.
     logprobs: bool = False
     top_logprobs: int = 0
+    # OpenAI logit_bias ({token_id: -100..100}, stored as pairs for
+    # hashability) and repetition penalties (-2..2): together they form
+    # one additive per-token bias applied to logits before sampling.
+    logit_bias: tuple[tuple[int, float], ...] = ()
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # Tokens generated before an engine restart (set by the scheduler's
+    # recovery path): penalty counting includes them, so sampling behavior
+    # does not silently change because a slice restarted mid-request.
+    penalty_history: tuple[int, ...] = ()
 
 
 # Candidate-set size for top-k / top-p sampling. Full-vocab SORTS are the

@@ -299,7 +299,13 @@ class Scheduler:
                 req.done.set()
                 continue
             req.prompt_ids = req.prompt_ids + partial
-            req.sampling = dc_replace(req.sampling, max_tokens=budget)
+            req.sampling = dc_replace(
+                req.sampling,
+                max_tokens=budget,
+                # Salvaged tokens fold into the prompt, but penalty
+                # counting must keep treating them as generated output.
+                penalty_history=tuple(req.generated_prefix),
+            )
             if req.mask_fn is not None and partial:
                 # Wrap with only THIS restart's salvage: after a second
                 # restart the inner fn already prepends the earlier
