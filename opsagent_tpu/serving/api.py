@@ -75,14 +75,96 @@ class ServingStack:
         except (ValueError, TypeError, KeyError) as e:
             raise RequestError(f"invalid request: {e}", 400) from e
 
+    def _tool_choice_constraint(self, body: dict[str, Any]):
+        """OpenAI ``tool_choice`` -> constrained-decoding mask_fn forcing a
+        tool_calls envelope: "required" constrains to a call of ANY listed
+        tool, {"type": "function", "function": {"name": N}} to that tool
+        specifically (arguments constrained to the tool's parameter schema
+        when it fits the FSM compiler's subset, any-JSON otherwise). The
+        structural guarantee the reference could never have — its remote
+        models free-text their calls (reference pkg/workflows/swarm.go)."""
+        tc = body.get("tool_choice")
+        tools = body.get("tools") or []
+        if tc in (None, "auto", "none"):
+            return None
+        names = [
+            t.get("function", {}).get("name")
+            for t in tools
+            if isinstance(t, dict) and t.get("function", {}).get("name")
+        ]
+        if not names:
+            raise ValueError("tool_choice requires a non-empty tools list")
+        args_schema: Any = {}
+        if tc == "required":
+            name_schema: dict[str, Any] = {"enum": names}
+        elif isinstance(tc, dict) and tc.get("type") == "function":
+            want = tc.get("function", {}).get("name")
+            if want not in names:
+                raise ValueError(
+                    f"tool_choice names unknown function {want!r}"
+                )
+            name_schema = {"enum": [want]}
+            for t in tools:
+                if (
+                    isinstance(t, dict)
+                    and t.get("function", {}).get("name") == want
+                ):
+                    args_schema = t["function"].get("parameters") or {}
+        else:
+            raise ValueError(f"unsupported tool_choice {tc!r}")
+        from .constrained import json_constraint
+
+        def envelope(args: Any) -> dict[str, Any]:
+            return {
+                "type": "object",
+                "properties": {
+                    "tool_calls": {
+                        "type": "array",
+                        "minItems": 1,
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "function": {
+                                    "type": "object",
+                                    "properties": {
+                                        "name": name_schema,
+                                        "arguments": args,
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            }
+
+        # depth=8: the envelope itself consumes 4 nesting levels (object ->
+        # array -> item -> function), so the default depth would compile
+        # "arguments" at depth 0 — primitives only, '{' forbidden.
+        try:
+            return json_constraint(
+                self.engine.tokenizer, envelope(args_schema), depth=8
+            )
+        except ValueError:
+            # Tool parameter schema outside the FSM compiler's subset:
+            # still force the envelope + name, arguments as any JSON.
+            return json_constraint(
+                self.engine.tokenizer, envelope({}), depth=8
+            )
+
     def _constraint_from(self, body: dict[str, Any]):
         """OpenAI ``response_format`` -> constrained-decoding mask_fn.
         ``json_object`` constrains to any JSON value; ``json_schema`` to the
         given schema (on-device FSM masking — the engine-side replacement
         for the reference's JSON-repair ladder, pkg/utils/json.go:16)."""
         rf = body.get("response_format")
+        tc_mask = self._tool_choice_constraint(body)
+        if rf and tc_mask is not None:
+            raise ValueError(
+                "response_format and a forcing tool_choice cannot be "
+                "combined (one constrained-decoding grammar per request)"
+            )
         if not rf:
-            return None
+            return tc_mask
         if not isinstance(rf, dict):
             raise ValueError(f"response_format must be an object, got {rf!r}")
         from .constrained import json_constraint
@@ -147,11 +229,15 @@ class ServingStack:
         )
 
     def _prompt_ids(self, body: dict[str, Any]) -> list[int]:
+        # tool_choice "none": the model must not see the tools at all.
+        tools = (
+            None if body.get("tool_choice") == "none" else body.get("tools")
+        )
         return apply_chat_template(
             self.engine.tokenizer,
             body.get("messages", []),
             model_family=self.model_name,
-            tools=body.get("tools"),
+            tools=tools,
         )
 
     def _finalize_text(
@@ -201,13 +287,54 @@ class ServingStack:
     # -- chat.completions ---------------------------------------------------
     def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
         sampling, prompt_ids, mask_fn = self._translate(body)
+        try:
+            n = int(body.get("n", 1) or 1)
+        except (TypeError, ValueError) as e:
+            raise RequestError(f"invalid n: {e}", 400) from e
+        if not 1 <= n <= 8:
+            raise RequestError("n must be in 1..8", 400)
         t0 = time.time()
-        req = Request(prompt_ids, sampling, mask_fn=mask_fn)
-        self.scheduler.submit(req)
-        if not req.done.wait(600):
-            raise TimeoutError("generation timed out")
-        if req.error:
-            raise RequestError(req.error, req.error_status)
+        # n choices = n engine requests sharing the prompt: the prefix
+        # cache dedups their KV, so extra choices only pay decode. Each
+        # request gets its OWN constraint instance — JsonConstraint walks
+        # the DFA incrementally per sequence, so sharing one across
+        # interleaved rows would cross their grammar states.
+        mask_fns = [mask_fn] + [
+            self._constraint_from(body) for _ in range(n - 1)
+        ]
+        reqs = [
+            Request(list(prompt_ids), sampling, mask_fn=mask_fns[i])
+            for i in range(n)
+        ]
+        for r in reqs:
+            self.scheduler.submit(r)
+        deadline = time.time() + 600
+        for r in reqs:
+            if not r.done.wait(max(0.0, deadline - time.time())):
+                raise TimeoutError("generation timed out")
+        errs = [r for r in reqs if r.error]
+        if errs:
+            raise RequestError(errs[0].error, errs[0].error_status)
+        choices = [
+            self._build_choice(i, r, sampling) for i, r in enumerate(reqs)
+        ]
+        total_completion = sum(len(r.tokens) for r in reqs)
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(t0),
+            "model": body.get("model") or self.model_name,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": total_completion,
+                "total_tokens": len(prompt_ids) + total_completion,
+            },
+        }
+
+    def _build_choice(
+        self, index: int, req: Request, sampling: SamplingParams
+    ) -> dict[str, Any]:
         tokens = req.tokens
         text, finish = self._finalize_text(tokens, sampling.stop, req.finish_reason)
         tool_calls = self._parse_tool_calls(text)
@@ -216,7 +343,7 @@ class ServingStack:
             message = {"role": "assistant", "content": None, "tool_calls": tool_calls}
             finish = "tool_calls"
         choice: dict[str, Any] = {
-            "index": 0, "message": message, "finish_reason": finish,
+            "index": index, "message": message, "finish_reason": finish,
         }
         if sampling.logprobs:
             tok = self.engine.tokenizer
@@ -258,18 +385,7 @@ class ServingStack:
                     for t, d in zip(lp_toks, req.logprob_data)
                 ]
             }
-        return {
-            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
-            "object": "chat.completion",
-            "created": int(t0),
-            "model": body.get("model") or self.model_name,
-            "choices": [choice],
-            "usage": {
-                "prompt_tokens": len(prompt_ids),
-                "completion_tokens": len(tokens),
-                "total_tokens": len(prompt_ids) + len(tokens),
-            },
-        }
+        return choice
 
     def chat_completion_stream(self, body: dict[str, Any]):
         """Generator of SSE chunk dicts (sync; drive from a thread)."""
@@ -280,6 +396,12 @@ class ServingStack:
             raise RequestError(
                 "logprobs are not supported with stream: true", 400
             )
+        try:
+            n = int(body.get("n", 1) or 1)
+        except (TypeError, ValueError) as e:
+            raise RequestError(f"invalid n: {e}", 400) from e
+        if n != 1:
+            raise RequestError("n > 1 is not supported with stream", 400)
         token_q: "queue.Queue[int | None]" = queue.Queue()
         req = Request(
             prompt_ids, sampling, mask_fn=mask_fn, on_token=lambda t: token_q.put(t)

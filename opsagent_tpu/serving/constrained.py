@@ -200,7 +200,12 @@ def compile_regex(node: Node) -> ByteDFA:
 
 
 # -- JSON schema → regex ----------------------------------------------------
-_WS = star(lit(b" \t\n\r"))
+# Inter-token whitespace, bounded to at most 2 chars (not star): models
+# emit compact or single-space JSON, and an unbounded run both lets a
+# degenerate/adversarial decode burn its whole budget on "\n\n\n..." and
+# inflates the DFA. Legal-JSON *parsing* is unaffected — this grammar
+# only shapes what we GENERATE.
+_WS = seq(opt(lit(b" \t\n\r")), opt(lit(b" \t\n\r")))
 
 # String body: any byte except '"', '\' and C0 controls, or an escape.
 _STRING_CHAR = lit(frozenset(range(0x20, 0x100)) - {0x22, 0x5C})
@@ -271,11 +276,11 @@ def schema_to_regex(schema: dict[str, Any] | None, depth: int = 4) -> Node:
         return seq(*parts)
     if t == "array":
         inner = schema_to_regex(schema.get("items"), depth - 1)
-        return seq(
-            lit(b"["), _WS,
-            opt(seq(inner, star(seq(_WS, lit(b","), _WS, inner)))),
-            _WS, lit(b"]"),
-        )
+        rest = star(seq(_WS, lit(b","), _WS, inner))
+        body = seq(inner, rest)
+        if not schema.get("minItems"):
+            body = opt(body)  # minItems >= 1 forbids the empty array
+        return seq(lit(b"["), _WS, body, _WS, lit(b"]"))
     if t == "string":
         return _STRING
     if t in ("number", "integer"):

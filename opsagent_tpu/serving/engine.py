@@ -159,6 +159,10 @@ class Sequence:
     logprob_data: list[dict] = field(default_factory=list)
     # Cached static logit_bias row [V] (built on first use).
     static_bias: Any = None
+    # Incremental {token_id: count} for presence/frequency penalties —
+    # maintained by _accept_token so penalized long generations stay
+    # O(distinct tokens) per step instead of re-counting the history.
+    penalty_counts: dict | None = None
 
 
 class Engine:
@@ -889,16 +893,17 @@ class Engine:
                 s.static_bias = row
             bias[i] = s.static_bias
             if p.presence_penalty or p.frequency_penalty:
-                hist = list(p.penalty_history) + s.tokens
-                if hist:
-                    ids, counts = np.unique(
-                        np.asarray(hist, np.int64), return_counts=True
-                    )
-                    sel = ids < V
-                    bias[i, ids[sel]] -= (
-                        p.presence_penalty
-                        + p.frequency_penalty * counts[sel]
-                    )
+                counts = s.penalty_counts
+                if counts is None and p.penalty_history:
+                    # Before the first accepted token: seed from salvage.
+                    counts = {}
+                    for t in p.penalty_history:
+                        counts[t] = counts.get(t, 0) + 1
+                for tid, c in (counts or {}).items():
+                    if 0 <= tid < V:
+                        bias[i, tid] -= (
+                            p.presence_penalty + p.frequency_penalty * c
+                        )
         return bias
 
     def _sample_one(self, logits: jax.Array, seqs: list[Sequence]) -> np.ndarray:
@@ -941,6 +946,13 @@ class Engine:
 
     def _accept_token(self, seq: Sequence, token: int) -> None:
         seq.tokens.append(token)
+        p = seq.params
+        if p.presence_penalty or p.frequency_penalty:
+            if seq.penalty_counts is None:
+                seq.penalty_counts = {}
+                for t in p.penalty_history:
+                    seq.penalty_counts[t] = seq.penalty_counts.get(t, 0) + 1
+            seq.penalty_counts[token] = seq.penalty_counts.get(token, 0) + 1
         if seq.stream is not None:
             seq.stream(token)
         if token == self.tokenizer.eos_id:

@@ -1,0 +1,134 @@
+"""OpenAI tool_choice (FSM-forced function calls) and n-choices support."""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from opsagent_tpu.serving.api import ServingStack
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.scheduler import RequestError
+
+KW = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+    num_pages=1024, max_pages_per_seq=128, max_batch_size=4,
+    prefill_buckets=(16, 64),
+)
+
+TOOLS = [
+    {"type": "function", "function": {
+        "name": "kubectl",
+        "parameters": {
+            "type": "object",
+            "properties": {"command": {"type": "string"}},
+        },
+    }},
+    {"type": "function", "function": {
+        "name": "trivy",
+        "parameters": {
+            "type": "object",
+            "properties": {"image": {"type": "string"}},
+        },
+    }},
+]
+
+
+@pytest.fixture(scope="module")
+def stack():
+    s = ServingStack(Engine(EngineConfig(**KW)))
+    yield s
+    s.close()
+
+
+def test_forced_function_emits_valid_call(stack):
+    """tool_choice naming a function: even a random tiny model MUST emit a
+    parseable tool_calls envelope calling exactly that function — the FSM
+    makes it structurally impossible not to. A logit_bias on the quote
+    byte keeps free-text string values short (random weights never close
+    quotes on their own), which also exercises mask+bias composition:
+    the bias must never override grammar-forbidden positions."""
+    resp = stack.chat_completion({
+        "messages": [{"role": "user", "content": "scan the image"}],
+        "tools": TOOLS,
+        "tool_choice": {"type": "function", "function": {"name": "trivy"}},
+        "logit_bias": {str(ord('"')): 100},
+        "max_tokens": 512, "temperature": 0,
+    })
+    choice = resp["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    calls = choice["message"]["tool_calls"]
+    assert calls[0]["function"]["name"] == "trivy"
+    json.loads(calls[0]["function"]["arguments"])  # valid JSON args
+
+
+def test_required_constrains_to_listed_tools(stack):
+    resp = stack.chat_completion({
+        "messages": [{"role": "user", "content": "do something"}],
+        "tools": TOOLS,
+        "tool_choice": "required",
+        "logit_bias": {str(ord('"')): 100},
+        "max_tokens": 512, "temperature": 0,
+    })
+    calls = resp["choices"][0]["message"]["tool_calls"]
+    assert calls[0]["function"]["name"] in ("kubectl", "trivy")
+
+
+def test_tool_choice_validation(stack):
+    with pytest.raises(RequestError):
+        stack.chat_completion({
+            "messages": [{"role": "user", "content": "x"}],
+            "tool_choice": "required",      # no tools listed
+        })
+    with pytest.raises(RequestError):
+        stack.chat_completion({
+            "messages": [{"role": "user", "content": "x"}],
+            "tools": TOOLS,
+            "tool_choice": {"type": "function",
+                            "function": {"name": "nope"}},
+        })
+    with pytest.raises(RequestError):
+        stack.chat_completion({
+            "messages": [{"role": "user", "content": "x"}],
+            "tools": TOOLS,
+            "tool_choice": "required",
+            "response_format": {"type": "json_object"},  # two grammars
+        })
+
+
+def test_n_choices(stack):
+    resp = stack.chat_completion({
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4, "temperature": 0, "n": 3,
+    })
+    assert [c["index"] for c in resp["choices"]] == [0, 1, 2]
+    # Greedy: all choices identical; usage sums completions.
+    texts = {c["message"]["content"] for c in resp["choices"]}
+    assert len(texts) == 1
+    assert resp["usage"]["completion_tokens"] == 12
+
+    with pytest.raises(RequestError):
+        stack.chat_completion({
+            "messages": [{"role": "user", "content": "x"}], "n": 9,
+        })
+    gen = stack.chat_completion_stream({
+        "messages": [{"role": "user", "content": "x"}],
+        "stream": True, "n": 2,
+    })
+    with pytest.raises(RequestError):
+        next(gen)
+
+
+def test_n_choices_with_constraint_use_distinct_fsm_walkers(stack):
+    """Regression: n>1 constrained requests must each get their OWN
+    JsonConstraint (the DFA walk is per-sequence state); a shared one
+    crosses grammar positions between interleaved rows."""
+    resp = stack.chat_completion({
+        "messages": [{"role": "user", "content": "go"}],
+        "tools": TOOLS,
+        "tool_choice": {"type": "function", "function": {"name": "kubectl"}},
+        "logit_bias": {str(ord('"')): 100},
+        "max_tokens": 512, "temperature": 0, "n": 2,
+    })
+    for c in resp["choices"]:
+        assert c["finish_reason"] == "tool_calls", c
+        assert c["message"]["tool_calls"][0]["function"]["name"] == "kubectl"
