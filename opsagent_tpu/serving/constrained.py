@@ -326,6 +326,7 @@ class TokenFSM:
         self.eos_id = eos_id
         self.vocab_size = len(token_bytes)
         self._mask_cache: dict[int, np.ndarray] = {}
+        self._dense: tuple[np.ndarray, np.ndarray] | None = None
         self._lens = np.array([len(tb) for tb in token_bytes], np.int32)
         maxlen = max(1, int(self._lens.max()))
         self._bytes = np.zeros((self.vocab_size, maxlen), np.int32)
@@ -347,6 +348,25 @@ class TokenFSM:
             except Exception:  # noqa: BLE001 - fallback is always correct
                 self._native = None
 
+    def _walk(self, state: int) -> tuple[np.ndarray, np.ndarray]:
+        """THE vectorized byte-walk: ([V] allow-mask, [V] final DFA state)
+        for one source state. Single source of truth for the lazy host
+        masks AND the dense device tables."""
+        nxt = self.dfa.next
+        st = np.full((self.vocab_size,), state, np.int32)
+        alive = self._lens > 0  # empty byte-strings (specials) forbidden
+        for j in range(self._bytes.shape[1]):
+            has = j < self._lens
+            step = alive & has
+            idx = np.where(step, st, 0) * 256 + self._bytes[:, j]
+            st = np.where(step, nxt[idx], st)
+            alive &= ~has | (st >= 0)
+        mask = alive
+        if self.dfa.accept[state]:
+            mask = mask.copy()
+            mask[self.eos_id] = True
+        return mask, st
+
     def mask_for_state(self, state: int) -> np.ndarray:
         if self._native is not None:
             return self._native.mask_for_state(state)
@@ -355,18 +375,7 @@ class TokenFSM:
             return cached
         mask = np.zeros((self.vocab_size,), bool)
         if state >= 0:
-            nxt = self.dfa.next
-            st = np.full((self.vocab_size,), state, np.int32)
-            alive = self._lens > 0  # empty byte-strings (specials) forbidden
-            for j in range(self._bytes.shape[1]):
-                has = j < self._lens
-                step = alive & has
-                idx = np.where(step, st, 0) * 256 + self._bytes[:, j]
-                st = np.where(step, nxt[idx], st)
-                alive &= ~has | (st >= 0)
-            mask = alive
-            if self.dfa.accept[state]:
-                mask[self.eos_id] = True
+            mask, _ = self._walk(state)
         self._mask_cache[state] = mask
         return mask
 
@@ -374,6 +383,45 @@ class TokenFSM:
         if self._native is not None:
             return self._native.advance(state, token_id)
         return self.dfa.run(state, self.token_bytes[token_id])
+
+    def _mask_dest_row(self, state: int) -> tuple[np.ndarray, np.ndarray]:
+        """One state's (allow-mask [V], destination [V]) for the device
+        tables, from the shared ``_walk``. Disallowed tokens' dest is 0
+        (never taken — the mask blocks them); the eos column stays in
+        place (eos ends generation, it is never advanced through the
+        DFA). Seeds the host mask cache as a side effect."""
+        mask, st = self._walk(state)
+        if self._native is None:
+            self._mask_cache.setdefault(state, mask)
+        # Device-table numbering: DFA state s lives at row s+1 (row 0 is
+        # the FREE sentinel), so shift destinations by one.
+        dest = np.where(mask, np.maximum(st, 0) + 1, 0).astype(np.int32)
+        dest[self.eos_id] = state + 1
+        return mask, dest
+
+    def dense_tables(self) -> tuple[np.ndarray, np.ndarray] | None:
+        """Full ([S+1, V] allow-mask, [S+1, V] dest) tables for DEVICE-side
+        FSM stepping inside the fused decode block (SURVEY §7's
+        "constrained-decode FSM on device without host sync per token").
+        ROW 0 is the FREE sentinel — everything allowed, dest = 0 — so
+        unconstrained rows sharing the batch ride at the zero-initialized
+        state no matter which schema's tables are loaded; DFA state s is
+        row s+1. Returns None when the tables exceed the memory budget
+        (the lazy host path still works). Built once and cached."""
+        if self._dense is not None:
+            return self._dense
+        S, V = self.dfa.num_states, self.vocab_size
+        # Same total-entries budget (and so the same operator knob) as the
+        # native-table gate above.
+        if (S + 1) * V > NATIVE_TABLE_BUDGET:
+            return None
+        mask = np.zeros((S + 1, V), bool)
+        dest = np.zeros((S + 1, V), np.int32)
+        mask[0] = True
+        for s in range(S):
+            mask[s + 1], dest[s + 1] = self._mask_dest_row(s)
+        self._dense = (mask, dest)
+        return self._dense
 
 
 class JsonConstraint:

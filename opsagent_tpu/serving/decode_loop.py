@@ -64,7 +64,7 @@ def decode_block(
     — because even a top-k candidate scan over a 128k vocab inside the
     decode loop costs several times the decode step itself on TPU.
     """
-    toks, cache, (_, _, _, key) = decode_block_carry(
+    toks, cache, (_, _, _, _, key) = decode_block_carry(
         params, cfg,
         carry_tok=tokens, carry_at=write_at,
         carry_eos=jnp.zeros_like(active), key=key,
@@ -103,7 +103,16 @@ def decode_block_carry(
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
     mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
-) -> tuple[jax.Array, Any, tuple[jax.Array, jax.Array, jax.Array, jax.Array]]:
+    # Device-side constrained decoding (SURVEY §7's hard part: the FSM
+    # steps on device, no host sync per token). fsm_mask/fsm_dest are the
+    # shared [S+1, V] tables — ROW 0 is the FREE sentinel (everything
+    # allowed, dest 0) so zero-initialized states mean "unconstrained";
+    # DFA state s lives at row s+1. carry_fsm/ov_fsm are per-row states.
+    fsm_mask: jax.Array | None = None,
+    fsm_dest: jax.Array | None = None,
+    carry_fsm: jax.Array | None = None,   # [B] int32
+    ov_fsm: jax.Array | None = None,      # [B] int32
+) -> tuple[jax.Array, Any, tuple]:
     """``decode_block`` with the loop state living ON DEVICE across
     dispatches, so the host can enqueue block k+1 before pulling block k's
     tokens (the pipelined engine path).
@@ -123,13 +132,23 @@ def decode_block_carry(
     at = jnp.where(override, ov_at, carry_at).astype(jnp.int32)
     eos = jnp.where(override, False, carry_eos)
     act0 = alive & ~eos & (budgets > 0)
+    with_fsm = fsm_mask is not None
+    if with_fsm:
+        fstate = jnp.where(override, ov_fsm, carry_fsm).astype(jnp.int32)
+    else:
+        fstate = jnp.zeros_like(tok)
 
     def body(carry, step_idx):
-        tok, at, eos, act, cache, key = carry
+        tok, at, eos, act, fstate, cache, key = carry
         logits, cache = llama.decode_step(
             params, cfg, tok, at, cache, page_table, act,
             dtype=dtype, attn_impl=attn_impl, mesh=mesh,
         )
+        if with_fsm:
+            # Grammar mask from the per-row DFA state: one [B, V] gather,
+            # no host round trip. NEG_INF (not -inf): masked logits feed
+            # a softmax in the sampled path.
+            logits = jnp.where(fsm_mask[fstate], logits, -1e30)
         if greedy:
             nxt = jnp.argmax(logits, axis=-1)
         else:
@@ -137,17 +156,19 @@ def decode_block_carry(
             nxt = sample(logits, sub, temps, top_k, top_p, None)
         nxt = jnp.where(act, nxt, tok).astype(jnp.int32)
         emitted = jnp.where(act, nxt, pad_id).astype(jnp.int32)
+        if with_fsm:
+            fstate = jnp.where(act, fsm_dest[fstate, nxt], fstate)
         at = at + act.astype(jnp.int32)
         eos = eos | (act & (nxt == eos_id))
         act = act & ~eos & (step_idx + 1 < budgets)
-        return (nxt, at, eos, act, cache, key), emitted
+        return (nxt, at, eos, act, fstate, cache, key), emitted
 
-    (tok, at, eos, _, cache, key), toks = jax.lax.scan(
+    (tok, at, eos, _, fstate, cache, key), toks = jax.lax.scan(
         body,
-        (tok, at, eos, act0, cache, key),
+        (tok, at, eos, act0, fstate, cache, key),
         jnp.arange(n_steps),
     )
-    return toks.T, cache, (tok, at, eos, key)
+    return toks.T, cache, (tok, at, eos, fstate, key)
 
 
 # -- speculative decoding (prompt-lookup / n-gram drafting) ------------------

@@ -374,6 +374,7 @@ class Engine:
             params, carry_tok, carry_at, carry_eos, key,
             override, ov_tok, ov_at, alive, budgets, cache, table,
             temps, top_k, top_p, greedy,
+            fsm_mask=None, fsm_dest=None, carry_fsm=None, ov_fsm=None,
         ):
             from .decode_loop import decode_block_carry
 
@@ -383,6 +384,8 @@ class Engine:
                 temps, top_k, top_p,
                 jnp.int32(self.tokenizer.eos_id),
                 jnp.int32(self.tokenizer.pad_id),
+                fsm_mask=fsm_mask, fsm_dest=fsm_dest,
+                carry_fsm=carry_fsm, ov_fsm=ov_fsm,
                 n_steps=self.cfg.decode_block,
                 greedy=greedy,
                 dtype=dt,
@@ -416,6 +419,7 @@ class Engine:
         self._hist = None  # device [B, H] token history for drafting
         self._ov_hist_zeros = None  # cached all-zeros ov_hist (no overrides)
         self._bias_buf = None  # reused host [B, V] logit-bias batch buffer
+        self._fsm_dev: dict = {}  # id(fsm) -> (fsm, device mask, device dest)
 
         def _spec_pipeline(
             params, carry_tok, carry_at, carry_eos, carry_hist,
@@ -446,7 +450,7 @@ class Engine:
         B = cfg.max_batch_size
         self._lanes: list[int | None] = [None] * B   # lane -> seq_id
         self._lane_of: dict[int, int] = {}           # seq_id -> lane
-        self._carry: tuple | None = None             # device (tok, at, eos, key)
+        self._carry: tuple | None = None  # device (tok, at, eos, fsm, key)
         from collections import deque
 
         self._inflight: deque = deque()              # dispatched, unpulled
@@ -546,6 +550,34 @@ class Engine:
                     self.cache, dropB, zf, zi, of,
                     greedy=greedy,
                 )
+            # Device-FSM decode variant, pre-specialized for the agent's
+            # primary constraint (the ReAct ToolPrompt schema): the first
+            # constrained request must not pay the dense-table build plus
+            # an XLA compile under the engine lock. Other schemas' table
+            # SHAPES still compile on first use (unknowable here).
+            try:
+                from .constrained import TOOLPROMPT_SCHEMA, json_constraint
+
+                con = json_constraint(self.tokenizer, TOOLPROMPT_SCHEMA)
+                if con.fsm.dense_tables() is not None:
+                    fm, fd = self._fsm_device_tables(con.fsm)
+                    for greedy in (True, False):
+                        self._sample_key, sub = jax.random.split(
+                            self._sample_key
+                        )
+                        toks, self.cache, _ = self._decode_pipeline_jit(
+                            self.params,
+                            jnp.zeros((B,), jnp.int32),
+                            jnp.zeros((B,), jnp.int32),
+                            jnp.zeros((B,), bool), sub,
+                            jnp.zeros((B,), bool), zi, zi, inactive, zi,
+                            self.cache, dropB, zf, zi, of,
+                            greedy=greedy,
+                            fsm_mask=fm, fsm_dest=fd,
+                            carry_fsm=zi, ov_fsm=zi,
+                        )
+            except Exception:  # noqa: BLE001 - warmup is best-effort
+                log.exception("ToolPrompt FSM warmup failed (non-fatal)")
             if self.cfg.speculative_k > 0:
                 H = self.cfg.max_pages_per_seq * self.cfg.page_size
                 zh = jnp.zeros((B, H), jnp.int32)
@@ -856,6 +888,21 @@ class Engine:
                 mask[i, :n] = m[:n]
                 mask[i, n:] = False
         return temps, top_k, top_p, mask
+
+    def _fsm_device_tables(self, fsm) -> tuple[jax.Array, jax.Array]:
+        """Device-resident ([S+1, V] mask, dest) for one TokenFSM, cached
+        (keyed by identity, holding the fsm so a reused id() can't alias).
+        Tiny LRU: schemas churn rarely and each table set re-specializes
+        the decode-block program anyway."""
+        ent = self._fsm_dev.get(id(fsm))
+        if ent is not None and ent[0] is fsm:
+            return ent[1], ent[2]
+        mask, dest = fsm.dense_tables()
+        m, d = jnp.asarray(mask), jnp.asarray(dest)
+        self._fsm_dev[id(fsm)] = (fsm, m, d)
+        while len(self._fsm_dev) > 2:
+            self._fsm_dev.pop(next(iter(self._fsm_dev)))
+        return m, d
 
     @staticmethod
     def _needs_bias(s: Sequence) -> bool:
@@ -1241,13 +1288,45 @@ class Engine:
             ]
             running = running[: self.cfg.max_batch_size]
             block = self.cfg.decode_block
-            # Host-stepped rows: constrained masks need a host-computed
-            # logits mask per token; logprob rows need per-token device
-            # pulls the pipelined block does not surface; biased rows
-            # (logit_bias / penalties) need the bias rebuilt per token.
+            # Constrained rows whose FSM fits the device-table budget ride
+            # the PIPELINED block: the grammar mask is a [B, V] table
+            # gather per step and the DFA state advances on device — no
+            # host sync per token (SURVEY §7's hard part). One shared
+            # table set per dispatch; seated fsm lanes pin the choice, and
+            # rows with a different schema fall back to host stepping.
+            from .constrained import JsonConstraint
+
+            fsm_obj = None
+            for sid in self._lanes:
+                s = self.sequences.get(sid) if sid is not None else None
+                if (
+                    s is not None and not s.done
+                    and isinstance(s.mask_fn, JsonConstraint)
+                ):
+                    fsm_obj = s.mask_fn.fsm
+                    break
+
+            def fsm_ok(s):
+                nonlocal fsm_obj
+                if (
+                    not isinstance(s.mask_fn, JsonConstraint)
+                    or s.params.logprobs
+                    or self._needs_bias(s)
+                    or s.mask_fn.fsm.dense_tables() is None
+                ):
+                    return False
+                if fsm_obj is None:
+                    fsm_obj = s.mask_fn.fsm
+                    return True
+                return s.mask_fn.fsm is fsm_obj
+
+            # Host-stepped rows: non-FSM constrained masks need a
+            # host-computed logits mask per token; logprob rows need
+            # per-token device pulls the pipelined block does not surface;
+            # biased rows need the bias rebuilt per token.
             def hosted(s):
                 return (
-                    s.mask_fn is not None
+                    (s.mask_fn is not None and not fsm_ok(s))
                     or s.params.logprobs
                     or self._needs_bias(s)
                 )
@@ -1288,6 +1367,7 @@ class Engine:
             override = np.zeros((B,), bool)
             ov_tok = np.zeros((B,), np.int32)
             ov_at = np.zeros((B,), np.int32)
+            ov_fsm = np.zeros((B,), np.int32)  # 0 = FREE sentinel row
             for s in plain:
                 if s.seq_id in self._lane_of:
                     continue
@@ -1301,6 +1381,15 @@ class Engine:
                 ov_tok[lane] = s.tokens[-1] if s.tokens else self.tokenizer.bos_id
                 # Invariant at (re)seating: alloc.length == written tokens.
                 ov_at[lane] = self.alloc.length(s.seq_id)
+                if isinstance(s.mask_fn, JsonConstraint):
+                    # Walk the DFA over what this row generated so far;
+                    # +1 because device-table row 0 is the FREE sentinel.
+                    fsm = s.mask_fn.fsm
+                    st = fsm.dfa.start
+                    for t in s.tokens:
+                        if t != fsm.eos_id:
+                            st = fsm.advance(st, t)
+                    ov_fsm[lane] = st + 1
             # Book pages for up to one block per lane; budgets account for
             # still-in-flight dispatches so max_tokens is never overshot.
             # Seated lanes OUTSIDE the caller's seq_ids filter keep their
@@ -1376,23 +1465,43 @@ class Engine:
             ]
             temps, top_k, top_p, _ = self._sampling_arrays(slots, B)
             greedy = bool(np.all(temps <= 0.0))
+            # A constrained row that failed to get a lane (batch full) must
+            # not force FSM tables (and disable speculation) on a dispatch
+            # where no SEATED row is constrained — it isn't advancing
+            # anyway. Re-derive from what actually seated.
+            if fsm_obj is not None and not any(
+                isinstance(
+                    getattr(self.sequences.get(sid), "mask_fn", None),
+                    JsonConstraint,
+                )
+                for sid in self._lanes
+                if sid is not None
+            ):
+                fsm_obj = None
             if self._carry is None:
                 # Fork the decode-loop PRNG stream off the admission stream
                 # so per-step sampling never reuses an admission key.
                 self._sample_key, carry_key = jax.random.split(self._sample_key)
-                # Distinct arrays: all four are donated, and donating the
-                # same buffer twice is an error.
+                # Distinct arrays: the donated args must be distinct
+                # buffers (donating the same one twice is an error).
                 self._carry = (
                     jnp.zeros((B,), jnp.int32),
                     jnp.zeros((B,), jnp.int32),
                     jnp.zeros((B,), bool),
+                    jnp.zeros((B,), jnp.int32),  # device FSM states (0=free)
                     carry_key,
                 )
-            c_tok, c_at, c_eos, c_key = self._carry
+            c_tok, c_at, c_eos, c_fsm, c_key = self._carry
             perf = get_perf_stats()
             t_disp = time.perf_counter()
-            speculate = self.cfg.speculative_k > 0 and greedy
+            speculate = (
+                self.cfg.speculative_k > 0 and greedy and fsm_obj is None
+            )
             counts = None
+            if fsm_obj is not None:
+                fsm_mask_d, fsm_dest_d = self._fsm_device_tables(fsm_obj)
+            else:
+                fsm_mask_d = fsm_dest_d = None
             if speculate:
                 # Host history for newly seated lanes, prepared OUTSIDE the
                 # dispatch timing block. Drafting is advisory (a stale row
@@ -1436,9 +1545,9 @@ class Engine:
                         )
                     )
                     n_tok, n_at, n_eos, self._hist = carry
-                    self._carry = (n_tok, n_at, n_eos, c_key)
+                    self._carry = (n_tok, n_at, n_eos, c_fsm, c_key)
                 else:
-                    toks, self.cache, self._carry = self._decode_pipeline_jit(
+                    toks, self.cache, carry = self._decode_pipeline_jit(
                         self.params,
                         c_tok, c_at, c_eos, c_key,
                         jnp.asarray(override),
@@ -1452,7 +1561,13 @@ class Engine:
                         jnp.asarray(top_k),
                         jnp.asarray(top_p),
                         greedy=greedy,
+                        fsm_mask=fsm_mask_d,
+                        fsm_dest=fsm_dest_d,
+                        carry_fsm=c_fsm,
+                        ov_fsm=jnp.asarray(ov_fsm),
                     )
+                    n_tok, n_at, n_eos, n_fsm, n_key = carry
+                    self._carry = (n_tok, n_at, n_eos, n_fsm, n_key)
                 dev_out.append(toks)
             perf.record_metric(
                 "engine.block_dispatch", (time.perf_counter() - t_disp) * 1e3,

@@ -1,0 +1,141 @@
+"""Device-side constrained decoding: the FSM steps ON DEVICE inside the
+pipelined decode block (table-gather mask + dest advance, no host sync per
+token — SURVEY §7's named hard part). Must be token-identical to the
+host-stepped constraint path."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.serving.constrained import json_constraint
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.sampler import SamplingParams
+
+KW = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+    num_pages=512, max_pages_per_seq=64, max_batch_size=4,
+    prefill_buckets=(16,),
+)
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"enum": ["kubectl", "trivy"]},
+        "ok": {"type": "boolean"},
+    },
+}
+
+
+def _run(engine, prompt, mask_fn, max_tokens=48):
+    sid = engine.begin_request(
+        prompt, SamplingParams(temperature=0.0, max_tokens=max_tokens),
+        mask_fn=mask_fn,
+    )
+    while not engine.prefill_step(sid):
+        pass
+    while not engine.sequences[sid].done:
+        engine.step_block([sid])
+    return engine.finish(sid)
+
+
+def test_device_fsm_matches_host_stepped():
+    prompt = [257, 1, 2, 3]
+    eng_host = Engine(EngineConfig(**KW))
+    host_con = json_constraint(eng_host.tokenizer, SCHEMA)
+    # A plain-function wrapper is NOT a JsonConstraint, so it host-steps.
+    want = _run(eng_host, prompt, lambda toks: host_con(toks))
+
+    eng_dev = Engine(EngineConfig(**KW))
+    dev_con = json_constraint(eng_dev.tokenizer, SCHEMA)
+    got = _run(eng_dev, prompt, dev_con)
+    assert got == want, (got, want)
+    # The device path actually engaged (tables cached on the engine).
+    assert eng_dev._fsm_dev, "device FSM tables were never built"
+
+
+def test_device_fsm_output_is_grammatical():
+    eng = Engine(EngineConfig(**KW))
+    con = json_constraint(eng.tokenizer, SCHEMA)
+    toks = _run(eng, [257, 9, 8], con, max_tokens=64)
+    fsm = con.fsm
+    st = fsm.dfa.start
+    for t in toks:
+        if t == fsm.eos_id:
+            break
+        st = fsm.advance(st, t)
+        assert st >= 0, "device-masked generation left the grammar"
+
+
+def test_device_fsm_mixed_with_plain_rows():
+    prompt_p = [257, 11, 22, 33]
+    eng = Engine(EngineConfig(**KW))
+    want_plain = eng.generate(
+        [prompt_p], SamplingParams(temperature=0.0, max_tokens=8)
+    )[0]
+    con = json_constraint(eng.tokenizer, SCHEMA)
+    a = eng.add_request(
+        prompt_p, SamplingParams(temperature=0.0, max_tokens=8)
+    )
+    b = eng.begin_request(
+        [257, 5, 6], SamplingParams(temperature=0.0, max_tokens=48),
+        mask_fn=con,
+    )
+    while not eng.prefill_step(b):
+        pass
+    pending = {a, b}
+    while pending:
+        eng.step_block(sorted(pending))
+        pending = {i for i in pending if not eng.sequences[i].done}
+    ta = eng.finish(a)
+    tb = eng.finish(b)
+    assert ta == want_plain  # plain neighbor unaffected by the FSM tables
+    st = con.fsm.dfa.start
+    for t in tb:
+        if t == con.fsm.eos_id:
+            break
+        st = con.fsm.advance(st, t)
+        assert st >= 0
+
+
+def test_two_schemas_one_rides_device_other_hosted():
+    eng = Engine(EngineConfig(**KW))
+    con1 = json_constraint(eng.tokenizer, SCHEMA)
+    con2 = json_constraint(
+        eng.tokenizer, {"type": "object",
+                        "properties": {"x": {"type": "integer"}}}
+    )
+    a = eng.begin_request(
+        [257, 1], SamplingParams(temperature=0.0, max_tokens=48),
+        mask_fn=con1,
+    )
+    b = eng.begin_request(
+        [257, 2], SamplingParams(temperature=0.0, max_tokens=48),
+        mask_fn=con2,
+    )
+    for sid in (a, b):
+        while not eng.prefill_step(sid):
+            pass
+    pending = {a, b}
+    while pending:
+        eng.step_block(sorted(pending))
+        pending = {i for i in pending if not eng.sequences[i].done}
+    for sid, con in ((a, con1), (b, con2)):
+        toks = eng.finish(sid)
+        st = con.fsm.dfa.start
+        for t in toks:
+            if t == con.fsm.eos_id:
+                break
+            st = con.fsm.advance(st, t)
+            assert st >= 0, (sid, toks)
+
+
+def test_budget_overflow_falls_back_to_host(monkeypatch):
+    from opsagent_tpu.serving import constrained as C
+
+    monkeypatch.setattr(C, "NATIVE_TABLE_BUDGET", 0)
+    eng = Engine(EngineConfig(**KW))
+    con = json_constraint(eng.tokenizer, SCHEMA)
+    assert con.fsm.dense_tables() is None
+    toks = _run(eng, [257, 4], con, max_tokens=32)
+    assert toks  # host fallback still generates
+    assert not eng._fsm_dev
