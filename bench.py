@@ -8,20 +8,25 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-On a TPU host, a plain `python bench.py` runs BOTH presets in isolated
-subprocesses — bench-1b first (guaranteed number), then the bench-8b
-headline (int8, the BASELINE 8B-class target) — and prints the 8B result
-with the 1B throughput alongside in `extra`. Model/batch are overridable
-via env (OPSAGENT_BENCH_MODEL, OPSAGENT_BENCH_BATCH, OPSAGENT_BENCH_STEPS),
-which runs that single configuration inline. On a CPU-only host the bench
-automatically drops to the tiny test model so it still completes; the
-recorded number is only meaningful on TPU.
+A plain `python bench.py` orchestrates up to three presets in isolated
+subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
+850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
+the guaranteed number), then the bench-8b int8 headline, then the
+BASELINE config-5 concurrent-sessions run. EVERY result line is printed
+and flushed the moment it exists (the driver kills this process at an
+unknown wall clock; an already-earned number must survive), and a
+combined headline line is printed last. If the default preset dies —
+e.g. the tunneled TPU is unreachable, which blocks jax backend init
+indefinitely (the round-2 rc=124 failure) — a cpu-pinned fallback child
+(TPU plugin stripped from its env) still produces a parsed line.
 
-OPSAGENT_BENCH_MODE=sessions switches to the BASELINE config-5 scenario:
-``batch`` concurrent client sessions submitting chat completions through
-the full stack (OpenAI translation -> scheduler admission -> chunked
-prefill -> pipelined decode), reporting aggregate tok/s/chip and the p50
-TTFT clients actually observed.
+Model/batch are overridable via env (OPSAGENT_BENCH_MODEL,
+OPSAGENT_BENCH_BATCH, OPSAGENT_BENCH_STEPS), which runs that single
+configuration inline. OPSAGENT_BENCH_MODE=sessions switches to the
+BASELINE config-5 scenario: ``batch`` concurrent client sessions
+submitting chat completions through the full stack (OpenAI translation
+-> scheduler admission -> chunked prefill -> pipelined decode),
+reporting aggregate tok/s/chip and the p50 TTFT clients observed.
 """
 
 from __future__ import annotations
@@ -43,87 +48,162 @@ def log(msg: str) -> None:
 
 
 def main() -> None:
-    # Plain `python bench.py` on a TPU host orchestrates BOTH presets in
-    # subprocesses (1B first for a guaranteed number, then the 8B-class
-    # headline). Explicit OPSAGENT_BENCH_MODEL/MODE requests run inline.
+    # Plain `python bench.py` orchestrates the presets in subprocesses
+    # (guaranteed-fast number first, headline after, sessions last, all
+    # under one wall-clock budget). Explicit OPSAGENT_BENCH_MODEL/MODE
+    # requests — and orchestrator children — run a single config inline.
     if (
-        os.environ.get("OPSAGENT_BENCH_MODEL")
+        os.environ.get("_OPSAGENT_BENCH_CHILD")
+        or os.environ.get("OPSAGENT_BENCH_MODEL")
         or os.environ.get("OPSAGENT_BENCH_MODE")
     ):
         run_single()
-    elif _probe_platform() == "tpu":
-        run_orchestrated()
     else:
-        run_single()
+        run_orchestrated()
 
 
-def _probe_platform() -> str:
-    """Platform of jax.devices()[0], probed in a SUBPROCESS so the parent
-    never initializes the TPU client itself — on single-chip tunneled
-    setups the parent holding the device would starve the child runs."""
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=300,
-        )
-        return out.stdout.strip().splitlines()[-1] if out.stdout else "none"
-    except Exception:  # noqa: BLE001
-        return "none"
+def _cpu_env() -> dict:
+    """Child env that can NEVER touch the TPU: strips the PJRT-plugin
+    sitecustomize trigger and pins the cpu platform. Used for the
+    last-resort fallback when the tunneled chip is unreachable (a wedged
+    tunnel blocks jax backend init indefinitely — the round-2 failure
+    mode), so the driver still records a parsed line proving the
+    harness works. ``None`` values mean REMOVE the var from the child env
+    (the same mechanism as __graft_entry__'s dryrun child)."""
+    return {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
 
 
-def _run_child(model: str, timeout_s: int) -> dict | None:
+def _run_child(env_extra: dict, timeout_s: float, tag: str) -> dict | None:
     """Run one bench preset in a subprocess; return its parsed JSON line.
-    Subprocess isolation means a wedged device link or OOM in one preset
-    cannot take down the other's already-collected result."""
-    import subprocess
 
-    env = dict(os.environ, OPSAGENT_BENCH_MODEL=model)
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-    except subprocess.TimeoutExpired:
-        log(f"bench[{model}]: TIMED OUT after {timeout_s}s")
+    Subprocess isolation means a wedged device link or OOM in one preset
+    cannot take down the other's already-collected result. The child's
+    stderr is INHERITED (progress streams to the driver's tail in real
+    time); stdout is captured on a reader thread so a timeout kill still
+    yields any JSON the child managed to print."""
+    import subprocess
+    import threading
+
+    if timeout_s < 60:
+        log(f"bench[{tag}]: skipped ({timeout_s:.0f}s left is too little)")
         return None
-    sys.stderr.write(out.stderr)
-    for line in reversed(out.stdout.strip().splitlines()):
+    env = dict(os.environ, _OPSAGENT_BENCH_CHILD="1")
+    for k, v in env_extra.items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=None, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    lines: list[str] = []
+
+    def _read() -> None:
+        for line in proc.stdout:
+            lines.append(line)
+
+    reader = threading.Thread(target=_read, daemon=True)
+    reader.start()
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"bench[{tag}]: TIMED OUT after {timeout_s:.0f}s, killing")
+        proc.kill()
+        proc.wait()
+    reader.join(timeout=10)
+    for line in reversed(lines):
         try:
             parsed = json.loads(line)
             if "metric" in parsed:
                 return parsed
         except json.JSONDecodeError:
             continue
-    log(f"bench[{model}]: no JSON result (rc={out.returncode})")
+    log(f"bench[{tag}]: no JSON result (rc={proc.returncode})")
     return None
 
 
 def run_orchestrated() -> None:
-    """TPU default: bench-1b first (the safe, known-good configuration —
-    its weights are generated on device, no bulk transfer), then the
-    bench-8b headline (BASELINE.md names an 8B-class model). Prints ONE
-    JSON line: the 8B result when it completes, with the 1B number
-    alongside in extra; the 1B result otherwise."""
-    r1b = _run_child("bench-1b", timeout_s=1200)
-    r8b = _run_child("bench-8b", timeout_s=1500)
-    if r8b is not None:
-        if r1b is not None:
-            r8b.setdefault("extra", {})["bench_1b_tok_s_chip"] = r1b["value"]
-        print(json.dumps(r8b))
-    elif r1b is not None:
-        r1b.setdefault("extra", {})["bench_8b"] = "failed (see stderr)"
-        print(json.dumps(r1b))
-    else:
-        log("bench: both presets failed")
+    """Budgeted multi-preset run. The contract with the driver (which
+    kills the whole process group at an unknown wall clock) is: flush
+    every result line the moment it exists, so a later kill can never
+    erase an already-earned number, and print the headline line LAST so
+    the driver's last-JSON-line parse picks it up.
+
+    Order: default preset (bench-1b on TPU, tiny-test elsewhere — the
+    guaranteed number), then the bench-8b int8 headline, then the
+    BASELINE config-5 concurrent-sessions run; stages 2 and 3 only start
+    if the remaining budget plausibly covers them."""
+    budget = float(os.environ.get("OPSAGENT_BENCH_BUDGET", "850"))
+    t_start = time.perf_counter()
+
+    def remaining() -> float:
+        return budget - (time.perf_counter() - t_start)
+
+    stage1_cap = float(os.environ.get("OPSAGENT_BENCH_STAGE1_CAP", "390"))
+    r1 = _run_child({}, min(stage1_cap, remaining() - 10), "default")
+    if r1 is None:
+        # Device unreachable or preset wedged: a cpu-pinned child (no TPU
+        # plugin) still proves the stack end to end and guarantees the
+        # driver a parsed line.
+        log("bench: default preset failed; falling back to cpu-pinned run")
+        r1 = _run_child(
+            {**_cpu_env(), "OPSAGENT_BENCH_MODEL": "tiny-test"},
+            min(180.0, remaining() - 10), "cpu-fallback",
+        )
+        if r1 is not None:
+            r1.setdefault("extra", {})["note"] = (
+                "cpu fallback: tpu device unreachable during bench window"
+            )
+    if r1 is not None:
+        print(json.dumps(r1), flush=True)
+    platform = (r1 or {}).get("extra", {}).get("platform", "")
+    headline = r1
+
+    r8b = None
+    if platform == "tpu" and remaining() > 420:
+        r8b = _run_child(
+            {"OPSAGENT_BENCH_MODEL": "bench-8b"}, remaining() - 10, "8b"
+        )
+        if r8b is not None:
+            print(json.dumps(r8b), flush=True)
+            headline = r8b
+    elif platform == "tpu":
+        log(f"bench: skipping 8b ({remaining():.0f}s left)")
+
+    rsess = None
+    if platform == "tpu" and remaining() > 240:
+        rsess = _run_child(
+            {"OPSAGENT_BENCH_MODE": "sessions",
+             "OPSAGENT_BENCH_MODEL": "bench-1b"},
+            remaining() - 10, "sessions",
+        )
+        if rsess is not None:
+            print(json.dumps(rsess), flush=True)
+    elif platform == "tpu":
+        log(f"bench: skipping sessions ({remaining():.0f}s left)")
+
+    if headline is None:
+        log("bench: no preset produced a number")
         sys.exit(1)
+    # Combined headline, printed last: the driver records one parsed line.
+    extra = dict(headline.get("extra", {}))
+    if r1 is not None and headline is not r1:
+        extra["bench_1b_tok_s_chip"] = r1["value"]
+    if rsess is not None:
+        extra["sessions_tok_s_chip"] = rsess["value"]
+        extra["sessions_p50_ttft_ms"] = rsess.get("extra", {}).get(
+            "p50_ttft_ms"
+        )
+    out = dict(headline, extra=extra)
+    print(json.dumps(out), flush=True)
 
 
 def run_single() -> None:
+    log("bench: acquiring device (backend init; hangs here = tunnel down)")
     platform = jax.devices()[0].platform
+    log(f"bench: device ready ({platform})")
     on_tpu = platform == "tpu"
     n_chips = len(jax.devices())
 
@@ -169,12 +249,16 @@ def run_single() -> None:
     eng = Engine(cfg)
     init_s = time.perf_counter() - t0
     log(f"bench: engine init (weights+shard) {init_s:.1f}s")
+    # Only compile the programs this bench dispatches ("bench"/"sessions"
+    # warmup levels): full warmup's program cross-product is what timed
+    # out the round-2 driver gate.
+    sessions_mode = os.environ.get("OPSAGENT_BENCH_MODE") == "sessions"
     t0 = time.perf_counter()
-    warmup_s = eng.warmup()
-    log(f"bench: warmup (all programs compiled) {warmup_s:.1f}s "
+    warmup_s = eng.warmup("sessions" if sessions_mode else "bench")
+    log(f"bench: warmup {warmup_s:.1f}s "
         f"(persistent cache makes repeat runs fast)")
 
-    if os.environ.get("OPSAGENT_BENCH_MODE") == "sessions":
+    if sessions_mode:
         run_sessions(eng, model, batch, steps, prompt_len, platform,
                      n_chips, quantize, init_s, warmup_s)
         return
@@ -243,8 +327,9 @@ def run_single() -> None:
             "init_s": round(init_s, 1),
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
+            "platform": platform,
         },
-    }))
+    }), flush=True)
 
 
 def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
@@ -336,8 +421,9 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
             "init_s": round(init_s, 1),
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
+            "platform": platform,
         },
-    }))
+    }), flush=True)
     stack.close()
 
 
