@@ -66,6 +66,97 @@ _lock = threading.Lock()
 _initialized = False
 
 
+class DailyRotatingFileHandler(logging.handlers.RotatingFileHandler):
+    """Size rotation WITHIN a day plus daily filename rotation and
+    age-based retention, matching the reference's lumberjack setup
+    (10MB/10 backups/7-day MaxAge/compress, logger.go:53-67) combined
+    with its daily filename reset (logger.go:70-98: the log file is
+    reopened under a new date-stamped name when the day changes).
+
+    ``logs/opsagent.log`` becomes ``logs/opsagent-YYYY-MM-DD.log``; when
+    the calendar date changes the handler switches to the new day's file
+    and prunes any log artifacts older than ``retention_days``. Rotated
+    same-day backups are gzip-compressed when ``compress`` is set
+    (lumberjack Compress, logger.go:66)."""
+
+    def __init__(
+        self,
+        file_path: str,
+        max_bytes: int = 10 * 1024 * 1024,
+        backup_count: int = 10,
+        retention_days: int = 7,
+        compress: bool = True,
+    ):
+        self._base = file_path
+        self._retention = retention_days
+        self._day = time.strftime("%Y-%m-%d")
+        super().__init__(
+            self._dated(), maxBytes=max_bytes, backupCount=backup_count,
+            delay=True,
+        )
+        if compress:
+            # The stdlib namer/rotator hooks keep the .N.gz names
+            # consistent through the backup shift loop (renaming the
+            # backups out-of-band instead would leave doRollover's shift
+            # finding nothing, silently dropping all but one backup).
+            self.namer = lambda name: name + ".gz"
+            self.rotator = self._gzip_rotate
+        # Enforce retention at startup too: short-lived processes (every
+        # CLI invocation) never cross midnight in-process, so rollover
+        # alone would never prune.
+        self.prune()
+
+    def _dated(self) -> str:
+        root, ext = os.path.splitext(self._base)
+        return f"{root}-{self._day}{ext}"
+
+    def shouldRollover(self, record: logging.LogRecord) -> bool:  # noqa: N802
+        if time.strftime("%Y-%m-%d") != self._day:
+            return True
+        return bool(super().shouldRollover(record))
+
+    def doRollover(self) -> None:  # noqa: N802
+        today = time.strftime("%Y-%m-%d")
+        if today != self._day:
+            # Day changed: reopen under the new date-stamped name (no
+            # backup shuffle — each day keeps its own file) and prune.
+            if self.stream:
+                self.stream.close()
+                self.stream = None
+            self._day = today
+            self.baseFilename = os.path.abspath(self._dated())
+            self.prune()
+            return
+        super().doRollover()
+
+    def prune(self) -> None:
+        """Delete log artifacts older than retention_days (lumberjack
+        MaxAge equivalent)."""
+        import glob
+
+        root, ext = os.path.splitext(self._base)
+        cutoff = time.time() - self._retention * 86400.0
+        for p in glob.glob(f"{root}-*{ext}*"):
+            try:
+                if os.path.getmtime(p) < cutoff:
+                    os.remove(p)
+            except OSError:  # racing writers / already gone
+                pass
+
+    @staticmethod
+    def _gzip_rotate(source: str, dest: str) -> None:
+        import gzip
+        import shutil
+
+        try:
+            with open(source, "rb") as src, gzip.open(dest, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            os.remove(source)
+        except OSError:  # fall back to a plain rename
+            if os.path.exists(source):
+                os.replace(source, dest.removesuffix(".gz"))
+
+
 def init_logger(
     level: str = "info",
     fmt: str = "json",
@@ -73,6 +164,8 @@ def init_logger(
     file_path: str = "logs/opsagent.log",
     max_size_mb: int = 10,
     max_backups: int = 10,
+    retention_days: int = 7,
+    compress: bool = True,
 ) -> logging.Logger:
     """Initialize the root 'opsagent' logger: rotating JSON file and/or
     colored console, mirroring the reference's tee of both cores."""
@@ -90,10 +183,12 @@ def init_logger(
             logger.addHandler(h)
         if output in ("file", "both"):
             os.makedirs(os.path.dirname(file_path) or ".", exist_ok=True)
-            fh = logging.handlers.RotatingFileHandler(
+            fh = DailyRotatingFileHandler(
                 file_path,
-                maxBytes=max_size_mb * 1024 * 1024,
-                backupCount=max_backups,
+                max_bytes=max_size_mb * 1024 * 1024,
+                backup_count=max_backups,
+                retention_days=retention_days,
+                compress=compress,
             )
             fh.setFormatter(JSONFormatter())
             logger.addHandler(fh)

@@ -83,3 +83,82 @@ def test_render_markdown_plain():
     out = render_markdown("# Title\n- item\n`code`\n", color=False)
     assert "TITLE" in out
     assert "• item" in out
+
+
+def test_logger_daily_rotation(tmp_path):
+    """Day change switches the log to a new date-stamped file (reference
+    logger.go:70-98 parity) and prunes artifacts past retention."""
+    import json
+    import logging
+    import os
+    import time
+
+    from opsagent_tpu.utils.logger import DailyRotatingFileHandler, JSONFormatter
+
+    base = str(tmp_path / "opsagent.log")
+    h = DailyRotatingFileHandler(base, retention_days=7)
+    h.setFormatter(JSONFormatter())
+    logger = logging.getLogger("test-daily")
+    logger.handlers = [h]
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+
+    logger.info("day one")
+    today = time.strftime("%Y-%m-%d")
+    assert os.path.exists(str(tmp_path / f"opsagent-{today}.log"))
+
+    # Simulate a date change: the handler's recorded day disagrees with
+    # the wall clock, so the next emit must roll to the new day's file.
+    h._day = "2000-01-01"
+    h.baseFilename = os.path.abspath(h._dated())
+    logger.info("day two")
+    assert os.path.exists(str(tmp_path / f"opsagent-{today}.log"))
+    with open(str(tmp_path / f"opsagent-{today}.log")) as f:
+        lines = [json.loads(ln) for ln in f]
+    assert any(e["msg"] == "day two" for e in lines)
+
+    # Retention: a file stamped old enough gets pruned.
+    stale = tmp_path / "opsagent-2000-01-01.log"
+    stale.write_text("old\n")
+    old = time.time() - 30 * 86400
+    os.utime(stale, (old, old))
+    h.prune()
+    assert not stale.exists()
+    h.close()
+
+
+def test_logger_size_rotation_compresses(tmp_path):
+    """Same-day size rotation keeps backups, gzip-compressed (lumberjack
+    Compress parity, reference logger.go:66)."""
+    import glob
+    import logging
+
+    from opsagent_tpu.utils.logger import DailyRotatingFileHandler
+
+    base = str(tmp_path / "opsagent.log")
+    h = DailyRotatingFileHandler(
+        base, max_bytes=512, backup_count=3, compress=True
+    )
+    logger = logging.getLogger("test-size-rot")
+    logger.handlers = [h]
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    for i in range(100):
+        logger.info("x" * 64 + str(i))
+    h.close()
+    gz = sorted(glob.glob(str(tmp_path / "opsagent-*.log.*.gz")))
+    # The shift chain must preserve MULTIPLE backups (.1.gz .2.gz .3.gz),
+    # not overwrite a single one — 100 records at 512B cap rotate far
+    # more than 3 times, so all backup slots must be occupied.
+    assert len(gz) == 3, f"expected 3 gzip backups, got {gz}"
+    import gzip as gzmod
+
+    total = sum(
+        len(gzmod.open(p, "rt").read().splitlines()) for p in gz
+    )
+    live = str(tmp_path / f"opsagent-{__import__('time').strftime('%Y-%m-%d')}.log")
+    with open(live) as f:
+        total += len(f.read().splitlines())
+    # backup_count bounds retention; with 3 slots of ~7 records plus the
+    # live file we must hold well over one rotation's worth.
+    assert total >= 20, f"only {total} records survived rotation"
