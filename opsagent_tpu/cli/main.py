@@ -123,9 +123,9 @@ def main(argv: list[str] | None = None) -> int:
         fmt=log_cfg.get("format", "json"),
         output=log_cfg.get("output", "stdout"),
         file_path=log_cfg.get("file", "logs/opsagent.log"),
-        max_size_mb=int(log_cfg.get("max_size_mb", 10)),
-        max_backups=int(log_cfg.get("max_backups", 10)),
-        retention_days=int(log_cfg.get("max_age_days", 7)),
+        max_size_mb=int(log_cfg.get("max_size_mb") or 10),
+        max_backups=int(log_cfg.get("max_backups") or 10),
+        retention_days=int(log_cfg.get("max_age_days") or 7),
         compress=bool(log_cfg.get("compress", True)),
     )
     log = get_logger("cli")

@@ -153,6 +153,12 @@ class DailyRotatingFileHandler(logging.handlers.RotatingFileHandler):
                 shutil.copyfileobj(src, dst)
             os.remove(source)
         except OSError:  # fall back to a plain rename
+            try:
+                # A partially written .gz must not enter the backup shift
+                # chain as a corrupt artifact.
+                os.remove(dest)
+            except OSError:
+                pass
             if os.path.exists(source):
                 os.replace(source, dest.removesuffix(".gz"))
 

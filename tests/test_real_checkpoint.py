@@ -1,0 +1,132 @@
+"""Real-checkpoint end-to-end coverage.
+
+Two layers:
+
+- ``test_agent_loop_from_saved_checkpoint``: hermetic. Saves a tiny model
+  as an HF-format safetensors checkpoint, boots a serving engine FROM THE
+  FILE (models.loader path), and runs the full ReAct agent loop against it
+  over the tpu:// in-process provider with a kubectl replay script —
+  the exact flow scripts/run_real_checkpoint.py drives with real weights.
+  The ToolPrompt constraint (agent/react.py tpu:// branch) guarantees
+  schema-valid JSON even from random weights, so the loop's mechanics are
+  fully exercised without a trained model.
+
+- ``test_real_open_weights_checkpoint``: runs only when
+  OPSAGENT_CHECKPOINT points at a real HF checkpoint dir (e.g.
+  Llama-3-8B-Instruct); drives scripts/run_real_checkpoint.py end to end.
+  This is the BASELINE config-2 capability proof (the reference instead
+  calls GPT-4 remotely: reference pkg/handlers/execute.go:205).
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def replay_kubectl(tmp_path, monkeypatch):
+    kubectl = tmp_path / "bin" / "kubectl"
+    kubectl.parent.mkdir()
+    kubectl.write_text(
+        "#!/bin/bash\n"
+        "printf 'default\\nkube-system\\nmonitoring\\n'\n"
+    )
+    kubectl.chmod(kubectl.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv(
+        "PATH", str(kubectl.parent) + os.pathsep + os.environ["PATH"]
+    )
+
+
+def test_agent_loop_from_saved_checkpoint(tmp_path, replay_kubectl):
+    from opsagent_tpu.agent.prompts import REACT_SYSTEM_PROMPT
+    from opsagent_tpu.agent.react import assistant_with_config
+    from opsagent_tpu.models import llama
+    from opsagent_tpu.models.config import TINY_TEST
+    from opsagent_tpu.models.loader import save_checkpoint
+    from opsagent_tpu.serving import api as serving_api
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    ckpt = tmp_path / "model.safetensors"
+    params = llama.init_params(
+        TINY_TEST, jax.random.PRNGKey(7), dtype=jnp.float32
+    )
+    save_checkpoint(str(ckpt), params)
+
+    engine = Engine(EngineConfig(
+        model="tiny-test",
+        checkpoint=str(ckpt),
+        dtype=jnp.float32,
+        num_pages=1024,
+        page_size=16,
+        max_pages_per_seq=320,
+        max_batch_size=2,
+        prefill_buckets=(256, 1024, 2048),
+    ))
+    stack = serving_api.ServingStack(engine)
+    serving_api.install_stack("ckpt-e2e", stack)
+    try:
+        messages = [
+            {"role": "system", "content": REACT_SYSTEM_PROMPT},
+            {"role": "user",
+             "content": "Here are the instructions: count namespaces"},
+        ]
+        answer, history = assistant_with_config(
+            "tpu://ckpt-e2e", messages, 256, False, False, 2, "", ""
+        )
+        # The loop must terminate with SOME answer. Every assistant turn
+        # must follow the ToolPrompt grammar from token one (the tpu://
+        # constraint guarantees structure even for random weights — the
+        # capability that deletes the reference's CleanJSON repair
+        # ladder); a turn may still be truncated JSON when random weights
+        # wander inside a string until the token cap, so completeness is
+        # only asserted for turns that parse.
+        assert isinstance(answer, str) and answer.strip()
+        assistant_turns = [
+            m for m in history if m.get("role") == "assistant"
+        ]
+        assert assistant_turns
+        for turn in assistant_turns:
+            content = str(turn["content"])
+            assert content.lstrip().startswith("{"), content[:80]
+            try:
+                parsed = json.loads(content)
+            except json.JSONDecodeError:
+                continue  # truncated at the generation cap
+            assert isinstance(parsed, dict)
+            assert set(parsed) <= {
+                "question", "thought", "action", "observation",
+                "final_answer",
+            }
+    finally:
+        stack.close()
+        serving_api._stacks.pop("ckpt-e2e", None)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("OPSAGENT_CHECKPOINT"),
+    reason="OPSAGENT_CHECKPOINT not set (no real open-weights checkpoint "
+           "available in this environment)",
+)
+def test_real_open_weights_checkpoint(tmp_path):
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "run_real_checkpoint.py"),
+            "--transcript", str(tmp_path / "transcript.md"),
+        ],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ},
+        cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    last = out.stdout.strip().splitlines()[-1]
+    assert json.loads(last)["ok"] is True
+    assert (tmp_path / "transcript.md").exists()
