@@ -114,6 +114,10 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _cfg_int(value, default: int) -> int:
+    return default if value is None else int(value)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = load_config(args.config or None)
@@ -123,9 +127,12 @@ def main(argv: list[str] | None = None) -> int:
         fmt=log_cfg.get("format", "json"),
         output=log_cfg.get("output", "stdout"),
         file_path=log_cfg.get("file", "logs/opsagent.log"),
-        max_size_mb=int(log_cfg.get("max_size_mb") or 10),
-        max_backups=int(log_cfg.get("max_backups") or 10),
-        retention_days=int(log_cfg.get("max_age_days") or 7),
+        # Null-in-YAML (a commented-out value) falls back to the default;
+        # an explicit 0 is preserved (maxBytes=0 / backupCount=0 are the
+        # stdlib's "disable" idioms).
+        max_size_mb=_cfg_int(log_cfg.get("max_size_mb"), 10),
+        max_backups=_cfg_int(log_cfg.get("max_backups"), 10),
+        retention_days=_cfg_int(log_cfg.get("max_age_days"), 7),
         compress=bool(log_cfg.get("compress", True)),
     )
     log = get_logger("cli")

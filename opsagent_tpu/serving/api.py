@@ -517,6 +517,12 @@ def install_stack(name: str, stack: ServingStack) -> None:
         _stacks[name] = stack
 
 
+def uninstall_stack(name: str) -> None:
+    """Remove a registered stack (the caller closes it)."""
+    with _stacks_lock:
+        _stacks.pop(name, None)
+
+
 def get_stack(name: str) -> ServingStack:
     # Engine construction happens under the lock: two racing first requests
     # must not each build a device-resident engine (the loser would leak

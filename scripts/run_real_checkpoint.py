@@ -35,24 +35,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import stat
 import sys
-import tempfile
 import time
 
-REPLAY_KUBECTL = """#!/bin/bash
-# Canned kubectl replay: enough surface for namespace/pod questions.
-args="$*"
-case "$args" in
-  *namespace*)
-    printf 'default\\nkube-system\\nkube-public\\nmonitoring\\n' ;;
-  *pod*)
-    printf 'web-1   Running\\nweb-2   CrashLoopBackOff\\n' ;;
-  *)
-    printf 'replay: no canned output for: %s\\n' "$args" >&2; exit 1 ;;
-esac
-"""
-
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -91,12 +77,9 @@ def main() -> int:
 
     # kubectl replay on PATH: the agent's tool layer runs `bash -c`, so a
     # script shadowing kubectl serves canned cluster state.
-    tooldir = tempfile.mkdtemp(prefix="opsagent-replay-")
-    kubectl = os.path.join(tooldir, "kubectl")
-    with open(kubectl, "w", encoding="utf-8") as f:
-        f.write(REPLAY_KUBECTL)
-    os.chmod(kubectl, os.stat(kubectl).st_mode | stat.S_IEXEC)
-    os.environ["PATH"] = tooldir + os.pathsep + os.environ["PATH"]
+    from opsagent_tpu.tools.replay import CLUSTER_SCRIPT, install_replay_kubectl
+
+    install_replay_kubectl(CLUSTER_SCRIPT)
 
     from opsagent_tpu.agent.prompts import REACT_SYSTEM_PROMPT
     from opsagent_tpu.agent.react import assistant_with_config

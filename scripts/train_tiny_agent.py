@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Train a tiny in-tree model to BE the ops agent, then serve it.
+
+The full-circle demo the reference cannot do (its "model" is a remote
+GPT-4 call, reference pkg/handlers/execute.go:205): using only this
+framework —
+
+1. generate ReAct transcripts in the exact wire format the agent loop
+   speaks (ToolPrompt JSON in/out, observation-marshaled-as-user-message,
+   byte-tokenizer chat template — the same code paths serving uses);
+2. fine-tune the tiny llama-family model on them with the in-tree
+   sharded train step (training/trainer.py) until it memorizes the
+   tool-calling behavior;
+3. save an HF-format safetensors checkpoint (models/loader.py);
+4. boot the serving engine FROM THAT CHECKPOINT and run the real agent
+   loop against it (tpu:// provider, FSM-constrained decoding, kubectl
+   replay tool);
+5. verify the agent answers the instruction correctly from trained
+   weights.
+
+Run: python scripts/train_tiny_agent.py [--steps 800] [--out DIR]
+Exits 0 iff the served agent produces the expected final answer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+# The demo trains and serves on CPU deterministically (also usable on a
+# chip, but CPU keeps it hermetic for tests).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+SYS_PROMPT = (
+    "You are a k8s ops agent. Reply with ToolPrompt JSON; use the kubectl "
+    "tool, then give final_answer."
+)
+INSTRUCTION = "count namespaces"
+KUBECTL_CMD = "kubectl get namespaces --no-headers | wc -l"
+FINAL_ANSWER = "There are 3 namespaces in the cluster."
+
+
+def build_dataset(tok):
+    """The two agent turns as (token_ids, loss_mask) training rows, built
+    with the SAME serialization code the live loop uses (tools.ToolPrompt,
+    chat_template.byte_template_ids) so serving-time prompts match the
+    training distribution byte for byte."""
+    from opsagent_tpu.serving.chat_template import byte_template_ids
+    from opsagent_tpu.serving.constrained import (
+        TOOLPROMPT_SCHEMA,
+        json_constraint,
+    )
+    from opsagent_tpu.tools import ToolAction, ToolPrompt
+
+    user1 = f"Here are the instructions: {INSTRUCTION}"
+    tp1 = ToolPrompt(
+        question=INSTRUCTION,
+        thought="I will count namespaces with kubectl.",
+        action=ToolAction(name="kubectl", input=KUBECTL_CMD),
+    )
+    reply1 = tp1.to_json()
+
+    # Turn 2's user message is EXACTLY what the loop marshals back: the
+    # turn-1 ToolPrompt with the observation filled in (react.py:193-194;
+    # the replay kubectl prints 3 lines, `wc -l` -> "3").
+    tp1_obs = ToolPrompt(
+        question=tp1.question, thought=tp1.thought, action=tp1.action,
+        observation="3",
+    )
+    tp2 = ToolPrompt(
+        question=INSTRUCTION,
+        thought="The observation shows 3 namespaces.",
+        observation="The cluster has 3 namespaces.",
+        final_answer=FINAL_ANSWER,
+    )
+    reply2 = tp2.to_json()
+
+    convs = [
+        ([{"role": "system", "content": SYS_PROMPT},
+          {"role": "user", "content": user1}], reply1),
+        ([{"role": "system", "content": SYS_PROMPT},
+          {"role": "user", "content": user1},
+          {"role": "assistant", "content": reply1},
+          {"role": "user", "content": tp1_obs.to_json()}], reply2),
+    ]
+
+    # Every training target must be REACHABLE under the ToolPrompt FSM the
+    # serving path enforces — otherwise the trained argmax fights the mask.
+    con = json_constraint(tok, TOOLPROMPT_SCHEMA)
+    for _, reply in convs:
+        dfa = con.fsm.dfa
+        state = dfa.run(dfa.start, reply.encode())
+        assert state >= 0 and dfa.accept[state], (
+            f"FSM rejects training target: {reply!r}"
+        )
+
+    rows = []
+    for messages, reply in convs:
+        prompt_ids = byte_template_ids(tok, messages)
+        reply_ids = tok.encode(reply) + [tok.eos_id]
+        ids = prompt_ids + reply_ids
+        mask = [0.0] * len(prompt_ids) + [1.0] * len(reply_ids)
+        rows.append((ids, mask))
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=800)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--target-loss", type=float, default=0.01)
+    ap.add_argument("--out", default="")
+    ap.add_argument("--skip-agent", action="store_true",
+                    help="train + save only (no serving run)")
+    args = ap.parse_args()
+
+    from opsagent_tpu.models.config import get_config_preset
+    from opsagent_tpu.models.loader import save_checkpoint
+    from opsagent_tpu.parallel.mesh import make_mesh
+    from opsagent_tpu.serving.tokenizer import ByteTokenizer
+    from opsagent_tpu.training import (
+        TrainConfig,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = get_config_preset("tiny-test")
+    tok = ByteTokenizer(vocab_size=cfg.vocab_size)
+    rows = build_dataset(tok)
+    S = 8 * ((max(len(ids) for ids, _ in rows) + 7) // 8)
+    B = len(rows)
+    tokens = np.full((B, S), tok.pad_id, np.int32)
+    mask = np.zeros((B, S), np.float32)
+    for i, (ids, m) in enumerate(rows):
+        tokens[i, :len(ids)] = ids
+        mask[i, :len(m)] = m
+    print(f"dataset: {B} rows, padded to S={S}", file=sys.stderr)
+
+    mesh = make_mesh(tp=1, dp=1, sp=1, devices=jax.devices()[:1])
+    tc = TrainConfig(learning_rate=args.lr, weight_decay=0.0, remat=False)
+    params, opt_state = init_train_state(
+        cfg, tc, mesh, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    step = make_train_step(cfg, tc, mesh, dtype=jnp.float32)
+    tokens_j = jnp.asarray(tokens)
+    mask_j = jnp.asarray(mask)
+
+    t0 = time.perf_counter()
+    loss = float("inf")
+    for i in range(args.steps):
+        params, opt_state, metrics = step(params, opt_state, tokens_j, mask_j)
+        if i % 50 == 0 or i == args.steps - 1:
+            loss = float(metrics["loss"])
+            print(f"step {i:4d} loss {loss:.4f} "
+                  f"({time.perf_counter()-t0:.0f}s)", file=sys.stderr)
+            if loss < args.target_loss:
+                break
+    print(f"trained to loss {loss:.4f} in {time.perf_counter()-t0:.0f}s",
+          file=sys.stderr)
+
+    out = args.out or tempfile.mkdtemp(prefix="opsagent-tiny-agent-")
+    os.makedirs(out, exist_ok=True)
+    ckpt = os.path.join(out, "model.safetensors")
+    save_checkpoint(ckpt, params)
+    print(f"checkpoint saved: {ckpt}", file=sys.stderr)
+    if args.skip_agent:
+        return 0
+    ok = run_agent(ckpt)
+    return 0 if ok else 1
+
+
+def run_agent(ckpt: str) -> bool:
+    """Serve the trained checkpoint and run the real agent loop on it."""
+    from opsagent_tpu.agent.react import assistant_with_config
+    from opsagent_tpu.serving import api as serving_api
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.tools import ToolPrompt
+    from opsagent_tpu.tools.replay import install_replay_kubectl
+
+    install_replay_kubectl()
+
+    engine = Engine(EngineConfig(
+        model="tiny-test",
+        checkpoint=ckpt,
+        dtype=jnp.float32,
+        num_pages=512,
+        page_size=16,
+        max_pages_per_seq=64,
+        max_batch_size=2,
+        prefill_buckets=(128, 512, 1024),
+    ))
+    stack = serving_api.ServingStack(engine)
+    serving_api.install_stack("tiny-agent", stack)
+    try:
+        messages = [
+            {"role": "system", "content": SYS_PROMPT},
+            {"role": "user",
+             "content": f"Here are the instructions: {INSTRUCTION}"},
+        ]
+        answer, history = assistant_with_config(
+            "tpu://tiny-agent", messages, 256, False, True, 4, "", ""
+        )
+        print("--- transcript ---", file=sys.stderr)
+        for m in history:
+            print(f"[{m['role']}] {str(m['content'])[:300]}", file=sys.stderr)
+        final = ToolPrompt.from_json(answer).final_answer
+        print(f"final answer: {final!r}")
+        ok = "3" in final and "namespace" in final.lower()
+        print(f"agent {'PASSED' if ok else 'FAILED'}")
+        return ok
+    finally:
+        stack.close()
+        serving_api.uninstall_stack("tiny-agent")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
