@@ -31,12 +31,17 @@ def paged_attention_backend() -> str:
     heads are tp-sharded, so each device streams only its own heads'
     pages)."""
     choice = os.environ.get("OPSAGENT_PAGED_BACKEND", "auto")
-    if choice in ("pallas", "xla"):
+    if choice in ("pallas", "pallas-dma", "xla"):
         return choice
     if choice != "auto":
         raise ValueError(
-            f"OPSAGENT_PAGED_BACKEND={choice!r}: expected pallas, xla, or auto"
+            f"OPSAGENT_PAGED_BACKEND={choice!r}: expected pallas, "
+            f"pallas-dma, xla, or auto"
         )
+    # "pallas-dma" (manual double-buffered page streaming) is the intended
+    # TPU default once compile-verified on hardware; until then auto keeps
+    # the proven grid kernel (interpret-mode tests cover semantics, not
+    # Mosaic lowering).
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
@@ -60,6 +65,18 @@ def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
         )
 
 
+def _pallas_kernel_fn(impl: str):
+    from .paged_attention_pallas import (
+        paged_decode_attention_pallas,
+        paged_decode_attention_pallas_dma,
+    )
+
+    return (
+        paged_decode_attention_pallas_dma if impl == "pallas-dma"
+        else paged_decode_attention_pallas
+    )
+
+
 def paged_decode_attention_pallas_tp(
     q: jax.Array,           # [B, H, D] — H sharded over tp
     k_pages: jax.Array,     # [N, P, K, D] or [L, N, P, K, D] — K over tp
@@ -69,6 +86,7 @@ def paged_decode_attention_pallas_tp(
     mesh: Mesh,
     layer: jax.Array | None = None,
     interpret: bool = False,
+    impl: str = "pallas",
 ) -> jax.Array:
     """The Pallas decode kernel under tensor parallelism.
 
@@ -79,7 +97,7 @@ def paged_decode_attention_pallas_tp(
     K/tp kv heads — the GQA group structure is preserved per shard and NO
     collective is needed (the head axis is fully data-parallel here; the
     all-reduce happens later at the wo row-parallel matmul)."""
-    from .paged_attention_pallas import paged_decode_attention_pallas
+    kernel = _pallas_kernel_fn(impl)
 
     spec_q = P(None, "tp", None)
     spec_kv = (
@@ -90,9 +108,7 @@ def paged_decode_attention_pallas_tp(
         layer = jnp.int32(0)
 
     def local(q, kp, vp, table, ln, ly):
-        return paged_decode_attention_pallas(
-            q, kp, vp, table, ln, interpret=interpret, layer=ly
-        )
+        return kernel(q, kp, vp, table, ln, interpret=interpret, layer=ly)
 
     mapped = _shard_map(
         local, mesh,
@@ -116,14 +132,13 @@ def paged_decode_attention_auto(
     ``paged_attention_backend``, resolved at trace time by the caller).
     With a mesh whose tp axis is >1, the Pallas path runs shard_mapped
     over tp (see ``paged_decode_attention_pallas_tp``)."""
-    if impl == "pallas":
+    if impl.startswith("pallas"):
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             return paged_decode_attention_pallas_tp(
-                q, k_pages, v_pages, page_table, lengths, mesh, layer=layer
+                q, k_pages, v_pages, page_table, lengths, mesh, layer=layer,
+                impl=impl,
             )
-        from .paged_attention_pallas import paged_decode_attention_pallas
-
-        return paged_decode_attention_pallas(
+        return _pallas_kernel_fn(impl)(
             q, k_pages, v_pages, page_table, lengths, layer=layer
         )
     return paged_decode_attention(

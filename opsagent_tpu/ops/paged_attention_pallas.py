@@ -1,12 +1,20 @@
-"""Pallas TPU kernel: ragged paged-attention for the decode step.
+"""Pallas TPU kernels: ragged paged-attention for the decode step.
 
 The XLA reference (``ops.attention.paged_decode_attention``) gathers every
 sequence's pages into a dense ``[B, MaxP*P, K, D]`` tensor each decode step —
 HBM traffic proportional to the page-table CAPACITY, not to the tokens
-actually resident. This kernel instead streams exactly the pages each
-sequence owns through VMEM via the Pallas pipeline (the scalar-prefetched
-page table drives the k/v BlockSpec index maps), with a flash-attention-style
-online softmax so nothing is materialized.
+actually resident. TWO kernels stream only the owned pages instead:
+
+- ``paged_decode_attention_pallas``: grid ``(B, MaxP)``, one page per grid
+  step via the automatic Pallas pipeline (scalar-prefetched page table
+  drives the k/v BlockSpec index maps). Simple, but pays a pipeline step
+  per PAGE SLOT — overhead-bound at decode shapes (VERDICT r2 weak #3).
+- ``paged_decode_attention_pallas_dma``: grid ``(B,)``, pages streamed
+  through two VMEM slots with manually double-buffered ``make_async_copy``
+  DMAs. One grid step per sequence; unowned page slots cost nothing.
+
+Both use a flash-attention-style online softmax so nothing is
+materialized.
 
 Grid: ``(B, MaxP)`` — page axis innermost so the f32 accumulators in VMEM
 scratch carry across a sequence's pages. Each grid step DMAs one whole page
@@ -124,6 +132,187 @@ def _page_index(b, p, table_ref, lengths_ref, base_ref, *, page_size):
     last = jnp.maximum(num_pages - 1, 0)
     page = table_ref[b, jnp.minimum(p, last)]
     return (jnp.maximum(page, 0) + base_ref[0], 0, 0, 0)
+
+
+def _kernel_dma(
+    # scalar prefetch
+    table_ref,     # [B, MaxP] int32 page indices (-1 = unassigned)
+    lengths_ref,   # [B] int32 tokens in cache (incl. the one being written)
+    base_ref,      # [1] int32 flat-page offset (layer * N; 0 without layers)
+    # blocks
+    q_ref,         # [1, H, D] VMEM
+    k_hbm,         # [Ntot, P, K, D] ANY (stays in HBM; pages DMA'd manually)
+    v_hbm,         # [Ntot, P, K, D] ANY
+    o_ref,         # [1, H, D] VMEM
+    # scratch
+    k_buf,         # [2, P, K, D] VMEM — double-buffered page slots
+    v_buf,         # [2, P, K, D] VMEM
+    k_sem,         # DMA semaphores (2,)
+    v_sem,
+    acc_ref,       # [H, D]  f32
+    m_ref,         # [H, 128] f32
+    l_ref,         # [H, 128] f32
+    *,
+    page_size: int,
+    num_kv_heads: int,
+    max_pages: int,
+):
+    """One grid step per SEQUENCE; its pages stream through two VMEM slots
+    via manually double-buffered DMAs. Versus the (B, MaxP) grid kernel
+    this removes the per-page pipeline step overhead that made that kernel
+    lose to the XLA gather at decode shapes (VERDICT r2 weak #3): the grid
+    is B steps total, page DMAs are issued one ahead of compute, and pages
+    past a sequence's length cost NOTHING (no step, no DMA) rather than a
+    clamped-index pipeline step."""
+    b = pl.program_id(0)
+    P = page_size
+    K = num_kv_heads
+    H = q_ref.shape[1]
+    G = H // K
+    D = q_ref.shape[-1]
+    length = lengths_ref[b]
+    # Pages this sequence actually owns, clamped to the table width: a
+    # length beyond MaxP*P (tolerated by the grid kernel via index
+    # clamping) must not drive table reads past [B, MaxP] or start a
+    # prefetch DMA the loop never waits on.
+    n = jnp.minimum(pl.cdiv(length, P), max_pages)
+
+    def k_dma(slot, i):
+        page = jnp.maximum(table_ref[b, i], 0) + base_ref[0]
+        return pltpu.make_async_copy(
+            k_hbm.at[page], k_buf.at[slot], k_sem.at[slot]
+        )
+
+    def v_dma(slot, i):
+        page = jnp.maximum(table_ref[b, i], 0) + base_ref[0]
+        return pltpu.make_async_copy(
+            v_hbm.at[page], v_buf.at[slot], v_sem.at[slot]
+        )
+
+    @pl.when(n > 0)
+    def _warmup():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * (D ** -0.5)          # [H, D]
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n)
+        def _prefetch():
+            k_dma(1 - slot, i + 1).start()
+            v_dma(1 - slot, i + 1).start()
+
+        k_dma(slot, i).wait()
+        v_dma(slot, i).wait()
+
+        kf = k_buf[slot].reshape(P * K, D)
+        vf = v_buf[slot].reshape(P * K, D)
+        s_full = jax.lax.dot_general(
+            q, kf,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                   # [H, P*K]
+        col = jax.lax.broadcasted_iota(jnp.int32, (H, P * K), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (H, P * K), 0)
+        sel = (col % K == row // G) & (i * P + col // K < length)
+        s = jnp.where(sel, s_full, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(s - m_new)
+        l_new = alpha[:, 0] * l_ref[:, 0] + jnp.sum(probs, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            probs, vf.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+    l = l_ref[:, :1]
+    safe = jnp.where(l > 0.0, l, 1.0)
+    o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas_dma(
+    q: jax.Array,           # [B, H, D] (one new token per sequence)
+    k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
+    v_pages: jax.Array,     # like k_pages
+    page_table: jax.Array,  # [B, MaxP] int32
+    lengths: jax.Array,     # [B] int32 (incl. the token being decoded)
+    interpret: bool = False,
+    layer: jax.Array | None = None,  # [] int32 with the layer-axis form
+) -> jax.Array:
+    """Manual-DMA paged decode attention: grid (B,), double-buffered page
+    streaming. Same contract as ``paged_decode_attention_pallas``."""
+    if k_pages.ndim == 5:
+        Lr, N, P, K, D = k_pages.shape
+        k_pages = k_pages.reshape(Lr * N, P, K, D)
+        v_pages = v_pages.reshape(Lr * N, P, K, D)
+        base = (layer if layer is not None else 0) * N
+    else:
+        N, P, K, D = k_pages.shape
+        base = 0
+    B, H, _ = q.shape
+    MaxP = page_table.shape[1]
+    base_arr = jnp.full((1,), base, jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, H, D), lambda b, t, ln, ba: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, D), lambda b, t, ln, ba: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, P, K, D), k_pages.dtype),
+            pltpu.VMEM((2, P, K, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel_dma, page_size=P, num_kv_heads=K, max_pages=MaxP
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * B * H * D * MaxP * P,
+            bytes_accessed=(
+                B * MaxP * P * K * D * 2 * k_pages.dtype.itemsize
+                + B * H * D * 2 * q.dtype.itemsize
+            ),
+            transcendentals=B * H * MaxP * P,
+        ),
+    )(
+        page_table.astype(jnp.int32), lengths.astype(jnp.int32), base_arr,
+        q, k_pages, v_pages,
+    )
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -319,7 +319,8 @@ class Engine:
         log.info(
             "paged decode attention impl: %s (tp=%d%s)",
             self.attn_impl, tp,
-            ", shard_map over tp" if self.attn_impl == "pallas" and tp > 1
+            ", shard_map over tp"
+            if self.attn_impl.startswith("pallas") and tp > 1
             else "",
         )
 

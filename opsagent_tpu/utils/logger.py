@@ -131,9 +131,11 @@ class DailyRotatingFileHandler(logging.handlers.RotatingFileHandler):
 
     def prune(self) -> None:
         """Delete log artifacts older than retention_days (lumberjack
-        MaxAge equivalent)."""
+        MaxAge equivalent; <= 0 means never expire, as MaxAge=0 does)."""
         import glob
 
+        if self._retention <= 0:
+            return
         root, ext = os.path.splitext(self._base)
         cutoff = time.time() - self._retention * 86400.0
         for p in glob.glob(f"{root}-*{ext}*"):

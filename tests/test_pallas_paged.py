@@ -12,7 +12,12 @@ import jax.numpy as jnp
 import pytest
 
 from opsagent_tpu.ops.attention import paged_decode_attention
-from opsagent_tpu.ops.paged_attention_pallas import paged_decode_attention_pallas
+from opsagent_tpu.ops.paged_attention_pallas import (
+    paged_decode_attention_pallas,
+    paged_decode_attention_pallas_dma,
+)
+
+KERNELS = [paged_decode_attention_pallas, paged_decode_attention_pallas_dma]
 
 
 def _make_case(
@@ -32,6 +37,7 @@ def _make_case(
     return q, k_pages, v_pages, jnp.asarray(table), jnp.asarray(lengths, jnp.int32)
 
 
+@pytest.mark.parametrize("kernel", KERNELS)
 @pytest.mark.parametrize(
     "B,H,K,D,P,MaxP,lengths",
     [
@@ -41,13 +47,13 @@ def _make_case(
         (2, 4, 4, 32, 8, 4, [9, 0]),           # inactive slot (length 0)
     ],
 )
-def test_pallas_matches_xla_reference(B, H, K, D, P, MaxP, lengths):
+def test_pallas_matches_xla_reference(B, H, K, D, P, MaxP, lengths, kernel):
     rng = np.random.default_rng(0)
     q, k_pages, v_pages, table, lens = _make_case(
         rng, B, H, K, D, P, MaxP, num_pages=B * MaxP + 2, lengths=lengths
     )
     ref = paged_decode_attention(q, k_pages, v_pages, table, lens)
-    got = paged_decode_attention_pallas(
+    got = kernel(
         q, k_pages, v_pages, table, lens, interpret=True
     )
     # Inactive slots: the kernel defines them as zeros; the reference
@@ -62,7 +68,8 @@ def test_pallas_matches_xla_reference(B, H, K, D, P, MaxP, lengths):
         np.testing.assert_array_equal(np.asarray(got)[~active], 0.0)
 
 
-def test_pallas_bf16_tolerance():
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_pallas_bf16_tolerance(kernel):
     rng = np.random.default_rng(1)
     q, k_pages, v_pages, table, lens = _make_case(
         rng, B=2, H=4, K=2, D=64, P=8, MaxP=4, num_pages=12, lengths=[13, 29]
@@ -71,7 +78,7 @@ def test_pallas_bf16_tolerance():
         x.astype(jnp.bfloat16) for x in (q, k_pages, v_pages)
     )
     ref = paged_decode_attention(q, k_pages, v_pages, table, lens)
-    got = paged_decode_attention_pallas(
+    got = kernel(
         q, k_pages, v_pages, table, lens, interpret=True
     )
     np.testing.assert_allclose(
@@ -194,3 +201,72 @@ def test_pallas_under_tp_layer_form():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
+
+
+def test_pallas_dma_under_tp_matches_oracle():
+    """The manual-DMA kernel under tensor parallelism (impl dispatch)."""
+    from opsagent_tpu.ops.attention import paged_decode_attention_pallas_tp
+    from opsagent_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tp=2, dp=1, sp=1, devices=jax.devices()[:2])
+    rng = np.random.default_rng(5)
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B=2, H=4, K=2, D=64, P=8, MaxP=4, num_pages=10,
+        lengths=[5, 17],
+    )
+    ref = paged_decode_attention(q, k_pages, v_pages, table, lens)
+    got = paged_decode_attention_pallas_tp(
+        q, k_pages, v_pages, table, lens, mesh, interpret=True,
+        impl="pallas-dma",
+    )
+    active = np.asarray(lens) > 0
+    np.testing.assert_allclose(
+        np.asarray(got)[active], np.asarray(ref)[active], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_pallas_dma_layer_form():
+    """Whole-cache [L, N, P, K, D] + layer offset on the DMA kernel."""
+    rng = np.random.default_rng(6)
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B=2, H=4, K=2, D=32, P=8, MaxP=3, num_pages=8,
+        lengths=[9, 20],
+    )
+    L = 3
+    k_l = jnp.stack([
+        jnp.asarray(rng.standard_normal(k_pages.shape), jnp.float32)
+        for _ in range(L)
+    ])
+    v_l = jnp.stack([
+        jnp.asarray(rng.standard_normal(v_pages.shape), jnp.float32)
+        for _ in range(L)
+    ])
+    for layer in (0, 2):
+        ref = paged_decode_attention(q, k_l[layer], v_l[layer], table, lens)
+        got = paged_decode_attention_pallas_dma(
+            q, k_l, v_l, table, lens, interpret=True, layer=jnp.int32(layer)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_pallas_dma_length_beyond_table_clamps():
+    """lengths > MaxP*P (tolerated by the grid kernel via clamping) must
+    not read the page table out of bounds or leak a prefetch DMA."""
+    rng = np.random.default_rng(7)
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B=2, H=4, K=2, D=32, P=8, MaxP=3, num_pages=8,
+        lengths=[24, 24],  # exactly fills all 3 pages
+    )
+    over = jnp.asarray([24, 40], jnp.int32)  # row 1 claims 5 pages of 3
+    ref = paged_decode_attention(q, k_pages, v_pages, table, jnp.asarray([24, 24], jnp.int32))
+    got = paged_decode_attention_pallas_dma(
+        q, k_pages, v_pages, table, over, interpret=True
+    )
+    # Row 0 is unaffected; row 1 attends over its 3 real pages only (the
+    # reference clamps identically), and nothing NaNs.
+    np.testing.assert_allclose(
+        np.asarray(got)[0], np.asarray(ref)[0], rtol=2e-5, atol=2e-5
+    )
+    assert not np.isnan(np.asarray(got)).any()

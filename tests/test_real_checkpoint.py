@@ -34,10 +34,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def replay_kubectl(tmp_path, monkeypatch):
     from opsagent_tpu.tools.replay import NAMESPACES_SCRIPT, install_replay_kubectl
 
-    old_path = os.environ["PATH"]
+    # Record the current PATH with monkeypatch so teardown restores it even
+    # though install_replay_kubectl mutates os.environ directly.
+    monkeypatch.setenv("PATH", os.environ["PATH"])
     install_replay_kubectl(NAMESPACES_SCRIPT, str(tmp_path / "bin"))
-    yield
-    os.environ["PATH"] = old_path
 
 
 def test_agent_loop_from_saved_checkpoint(tmp_path, replay_kubectl):

@@ -162,3 +162,21 @@ def test_logger_size_rotation_compresses(tmp_path):
     # backup_count bounds retention; with 3 slots of ~7 records plus the
     # live file we must hold well over one rotation's worth.
     assert total >= 20, f"only {total} records survived rotation"
+
+
+def test_logger_retention_zero_never_prunes(tmp_path):
+    """max_age_days <= 0 = keep forever (lumberjack MaxAge=0 idiom); it
+    must NOT mean 'prune everything on startup'."""
+    import os
+    import time
+
+    from opsagent_tpu.utils.logger import DailyRotatingFileHandler
+
+    stale = tmp_path / "opsagent-2000-01-01.log"
+    stale.write_text("ancient\n")
+    old = time.time() - 3650 * 86400
+    os.utime(stale, (old, old))
+    h = DailyRotatingFileHandler(str(tmp_path / "opsagent.log"), retention_days=0)
+    h.prune()
+    assert stale.exists()
+    h.close()
