@@ -505,6 +505,7 @@ def _run_stack(
     attn_fn: AttnFn,
     cache: Params | None,
     remat: bool = False,
+    stacks: tuple[str, ...] | None = None,
 ) -> tuple[jax.Array, Params | None, jax.Array]:
     """Run the dense stack then (if configured) the MoE stack; returns
     (final hidden states, updated cache or None, summed MoE aux loss).
@@ -535,10 +536,19 @@ def _run_stack(
         kc = vc = jnp.zeros((0,), x.dtype)  # pytree placeholder
     else:
         kc, vc = cache["k"], cache["v"]
-    carry = (x, jnp.zeros((), jnp.float32), kc, vc, jnp.int32(0))
-    if Ld:
+    # The aux init inherits x's varying-manual-axes type via an O(1)
+    # numeric no-op (one element, not a reduction — XLA cannot fold float
+    # 0*x): under shard_map manual (parallel/pipeline.py) the scan body's
+    # aux output is varying over the manual axes, and scan requires the
+    # initial carry to match; outside manual contexts this is plain zero.
+    aux0 = x.reshape(-1)[0].astype(jnp.float32) * 0.0
+    carry = (x, aux0, kc, vc, jnp.int32(0))
+    # stacks=None runs the full config-implied stack (missing keys raise
+    # loudly); pipeline stages (parallel/pipeline.py) pass the subset they
+    # own explicitly rather than relying on silent key-presence dispatch.
+    if Ld and (stacks is None or "layers" in stacks):
         carry, _ = jax.lax.scan(make_body(False), carry, params["layers"])
-    if Lm:
+    if Lm and (stacks is None or "moe_layers" in stacks):
         carry, _ = jax.lax.scan(make_body(True), carry, params["moe_layers"])
     x, aux, kc, vc, _ = carry
     if cache is None:

@@ -39,9 +39,17 @@ GPipe (synchronous) rather than interleaved/1F1B: the bubble is
 scheduling composes with ``jax.grad`` as plain autodiff through the scan —
 no hand-written backward schedule.
 
-MoE models: not supported under pp>1 yet (their layer stack is split
-dense-then-moe into two scans; staging across the two stacks needs a
-layout decision that EP should drive). ``make_pipeline_loss`` raises.
+MoE models (the DeepSeek-class layout, VERDICT r2 weak #7): the layer
+stack is dense-then-moe (``moe_layer_start`` dense layers, then MoE). The
+MoE stack — where the weight is — shards over ``pp`` ((L - ms) %% PP == 0
+required); the small dense prefix stays REPLICATED and logically belongs
+to stage 0 (every stage computes it each tick and a ``where`` keeps only
+stage 0's result — wasted FLOPs proportional to the 1-3 prefix layers,
+in exchange for no special-cased stage program). Expert weights keep
+their ``ep``/``tp`` axes inside each stage (GSPMD auto-sharding), so
+EP x PP x TP compose on one mesh. The router load-balance aux is
+accumulated only over each stage's VALID (non-bubble) microbatches and
+averaged back to the non-pipelined scale.
 """
 
 from __future__ import annotations
@@ -57,22 +65,25 @@ from ..models.config import ModelConfig
 
 
 def param_specs_pp(cfg: ModelConfig) -> Any:
-    """``models.llama.param_specs`` with the stacked layer arrays' leading
+    """``models.llama.param_specs`` with the pipelined stack's leading
     (layer) axis sharded over ``pp``: each pipeline stage holds only its
     own layers. Embedding/head/final-norm stay replicated over pp (stage 0
     embeds, the last stage projects; replication keeps the spec simple and
-    the arrays are small next to the layer stack)."""
+    the arrays are small next to the layer stack). For MoE models only the
+    MoE stack pipelines; the small dense prefix stays replicated (it runs
+    on stage 0 — see the module docstring)."""
     specs = llama.param_specs(cfg)
 
     def stage_shard(spec: P) -> P:
         return P("pp", *spec[1:])
 
-    specs["layers"] = {
-        k: stage_shard(s) for k, s in specs["layers"].items()
-    }
     if "moe_layers" in specs:
         specs["moe_layers"] = {
             k: stage_shard(s) for k, s in specs["moe_layers"].items()
+        }
+    else:
+        specs["layers"] = {
+            k: stage_shard(s) for k, s in specs["layers"].items()
         }
     return specs
 
@@ -83,25 +94,30 @@ def make_pipeline_loss(
     microbatches: int,
     dtype: jnp.dtype = jnp.bfloat16,
     remat: bool = False,
+    moe_aux_weight: float = 0.0,
 ) -> Callable:
     """Build ``loss_fn(params, tokens [B,S], loss_mask [B,S]) ->
     (loss, (ce, aux))`` running the layer stack as a PP-stage pipeline.
-    Drop-in for the trainer's dense loss path; params must be sharded with
-    ``param_specs_pp``. Requires L %% PP == 0 and B %% microbatches == 0.
+    Drop-in for the trainer's loss path; params must be sharded with
+    ``param_specs_pp``. Requires B %% microbatches == 0 and (dense models)
+    L %% PP == 0 / (MoE models) (L - moe_layer_start) %% PP == 0.
     """
-    if cfg.moe is not None:
-        raise NotImplementedError(
-            "pipeline parallelism currently supports dense models only "
-            "(MoE staging lands with expert parallelism)"
-        )
     PP = mesh.shape["pp"]
     M = microbatches
-    if cfg.num_layers % PP:
+    is_moe = cfg.moe is not None
+    Ld, Lm = llama._layer_split(cfg)
+    if is_moe:
+        if Lm % PP:
+            raise ValueError(
+                f"moe layers {Lm} not divisible by pp={PP}"
+            )
+    elif cfg.num_layers % PP:
         raise ValueError(
             f"num_layers={cfg.num_layers} not divisible by pp={PP}"
         )
 
-    def run_stage(stage_layers: Any, x: jax.Array, cos, sin) -> jax.Array:
+    def run_stage(stage_params: Any, x: jax.Array, cos, sin):
+        """Run a slice of the stack; returns (x, summed MoE aux)."""
         mb, S = x.shape[:2]
 
         def attn_fn(h, lp, kc, vc, li):
@@ -115,11 +131,11 @@ def make_pipeline_loss(
             attn = causal_prefill_attention(q, k, v)
             return attn.reshape(mb, S, -1), kc, vc
 
-        x, _, _ = llama._run_stack(
-            {"layers": stage_layers}, cfg, x, attn_fn, cache=None,
-            remat=remat,
+        x, _, aux = llama._run_stack(
+            stage_params, cfg, x, attn_fn, cache=None, remat=remat,
+            stacks=tuple(stage_params),
         )
-        return x
+        return x, aux
 
     def pipelined(params, tokens, loss_mask):
         # Inside shard_map manual over (pp, dp): tokens are the per-dp-shard
@@ -154,13 +170,41 @@ def make_pipeline_loss(
         reg0 = jax.lax.pcast(
             jnp.zeros((mb, S, d), dtype), ("pp", "dp"), to="varying"
         )  # pipeline register
+        aux0 = jax.lax.pcast(
+            jnp.zeros((), jnp.float32), ("pp", "dp"), to="varying"
+        )
 
         def tick(carry, t):
-            reg, outs = carry
+            reg, outs, aux_acc = carry
             x_in = jnp.where(
                 stage == 0, xs[jnp.clip(t, 0, M - 1)], reg
             )
-            h = run_stage(params["layers"], x_in, cos, sin)
+            if is_moe:
+                # The replicated dense prefix logically belongs to stage
+                # 0: every stage computes it (Ld is 1-3 layers — cheap
+                # next to the stage's Lm/PP MoE layers) and the where
+                # keeps only stage 0's result, so all stages run one
+                # uniform program. Prefix activations are finite, so the
+                # discarded branch preserves the finiteness invariant.
+                if Ld:
+                    xd, _ = run_stage(
+                        {"layers": params["layers"]}, x_in, cos, sin
+                    )
+                    x_in = jnp.where(stage == 0, xd, x_in)
+                h, aux_t = run_stage(
+                    {"moe_layers": params["moe_layers"]}, x_in, cos, sin
+                )
+            else:
+                h, aux_t = run_stage(
+                    {"layers": params["layers"]}, x_in, cos, sin
+                )
+            # Router aux only from REAL microbatches: during warmup/drain
+            # ticks a stage chews zeros (bubble), whose routing stats
+            # would pollute the load-balance signal.
+            mb_idx = t - stage
+            aux_acc = aux_acc + jnp.where(
+                (mb_idx >= 0) & (mb_idx < M), aux_t, 0.0
+            )
             # Advance the register one stage (non-cyclic: the last
             # stage's h leaves the pipeline into outs instead).
             reg = jax.lax.ppermute(
@@ -171,10 +215,10 @@ def make_pipeline_loss(
             outs = outs.at[jnp.where(valid, out_idx, M)].set(
                 h, mode="drop"
             )
-            return (reg, outs), None
+            return (reg, outs, aux_acc), None
 
-        (_, outs), _ = jax.lax.scan(
-            tick, (reg0, outs0), jnp.arange(M + PP - 1)
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            tick, (reg0, outs0, aux0), jnp.arange(M + PP - 1)
         )
 
         # Loss head, replicated: only the last stage's outs are final-layer
@@ -199,14 +243,33 @@ def make_pipeline_loss(
         # AND over dp shards (each saw its own batch slice).
         sums = jax.lax.psum(sums, ("pp", "dp"))
         ce = sums[0] / jnp.maximum(sums[1], 1.0)
-        return ce, (ce, jnp.zeros((), jnp.float32))
+        # Aux back to the non-pipelined scale: each of the M microbatches
+        # contributed its own per-layer routing stats (vs ONE whole-batch
+        # stat in the unpipelined step), and dp shards each counted their
+        # slice — mean over both.
+        dp_size = jax.lax.axis_size("dp")
+        aux = jax.lax.psum(aux_acc, ("pp", "dp")) / (M * dp_size)
+        return ce + moe_aux_weight * aux, (ce, aux)
 
-    layer_specs = {k: P("pp") for k in llama.param_specs(cfg)["layers"]}
+    base_specs = llama.param_specs(cfg)
     param_in_specs = {
         "embed": P(),
-        "layers": layer_specs,
         "final_norm": P(),
     }
+    if is_moe:
+        # Dense prefix replicated over pp; MoE stack pp-sharded on its
+        # leading (layer) axis. ep/tp stay on GSPMD auto-sharding.
+        if "layers" in base_specs:
+            param_in_specs["layers"] = {
+                k: P() for k in base_specs["layers"]
+            }
+        param_in_specs["moe_layers"] = {
+            k: P("pp") for k in base_specs["moe_layers"]
+        }
+    else:
+        param_in_specs["layers"] = {
+            k: P("pp") for k in base_specs["layers"]
+        }
     if not cfg.tie_embeddings:
         param_in_specs["lm_head"] = P()
 

@@ -102,11 +102,12 @@ def train_param_specs(cfg: ModelConfig, mesh: Mesh) -> Any:
     pp = mesh.shape.get("pp", 1)
     if pp > 1:
         if cfg.moe is not None:
-            raise NotImplementedError(
-                "pipeline parallelism currently supports dense models only "
-                "(MoE staging lands with expert parallelism)"
-            )
-        if cfg.num_layers % pp:
+            _, lm = llama._layer_split(cfg)
+            if lm % pp:
+                raise ValueError(
+                    f"moe layers {lm} not divisible by pp={pp}"
+                )
+        elif cfg.num_layers % pp:
             raise ValueError(
                 f"num_layers={cfg.num_layers} not divisible by pp={pp}"
             )
@@ -154,7 +155,8 @@ def make_train_step(
         from ..parallel.pipeline import make_pipeline_loss
 
         loss_fn = make_pipeline_loss(
-            cfg, mesh, tc.pp_microbatches, dtype=dtype, remat=tc.remat
+            cfg, mesh, tc.pp_microbatches, dtype=dtype, remat=tc.remat,
+            moe_aux_weight=tc.moe_aux_weight,
         )
     else:
         def loss_fn(params, tokens, loss_mask):
