@@ -184,6 +184,25 @@ def run_orchestrated() -> None:
     elif platform == "tpu":
         log(f"bench: skipping sessions ({remaining():.0f}s left)")
 
+    # Speculative decoding (PERF.md plan item 3): same 1B preset with
+    # prompt-lookup drafting on. With random weights and uniform-random
+    # prompts acceptance is ~0, so value-vs-stage-1 measures the WORST
+    # CASE: pure drafting/verification overhead. The upside (accept-rate
+    # on re-emitted JSON scaffolding) needs trained weights — see
+    # scripts/run_real_checkpoint.py.
+    SPEC_K = 4
+    rspec = None
+    if platform == "tpu" and remaining() > 180:
+        rspec = _run_child(
+            {"OPSAGENT_BENCH_MODEL": "bench-1b",
+             "OPSAGENT_BENCH_SPEC": str(SPEC_K)},
+            remaining() - 10, "spec",
+        )
+        if rspec is not None:
+            print(json.dumps(rspec), flush=True)
+    elif platform == "tpu":
+        log(f"bench: skipping spec ({remaining():.0f}s left)")
+
     if headline is None:
         log("bench: no preset produced a number")
         sys.exit(1)
@@ -196,6 +215,8 @@ def run_orchestrated() -> None:
         extra["sessions_p50_ttft_ms"] = rsess.get("extra", {}).get(
             "p50_ttft_ms"
         )
+    if rspec is not None:
+        extra[f"spec{SPEC_K}_overhead_tok_s_chip"] = rspec["value"]
     out = dict(headline, extra=extra)
     print(json.dumps(out), flush=True)
 
@@ -235,6 +256,7 @@ def run_single() -> None:
     # Large pages (fewer gather/grid steps per decode) and a page budget of
     # 128 prompt + 512 generated + slack for the decode pipeline's lookahead
     # (decode_block x (pipeline_depth + 1) tokens are pre-booked).
+    spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     cfg = EngineConfig(
         model=model,
         dtype=dtype,
@@ -244,6 +266,7 @@ def run_single() -> None:
         max_pages_per_seq=12,
         prefill_buckets=(prompt_len,),
         quantize=quantize,
+        speculative_k=spec_k,
     )
     t0 = time.perf_counter()
     eng = Engine(cfg)
@@ -254,7 +277,13 @@ def run_single() -> None:
     # out the round-2 driver gate.
     sessions_mode = os.environ.get("OPSAGENT_BENCH_MODE") == "sessions"
     t0 = time.perf_counter()
-    warmup_s = eng.warmup("sessions" if sessions_mode else "bench")
+    if sessions_mode:
+        level = "sessions"
+    elif spec_k > 0:
+        level = "bench-spec"
+    else:
+        level = "bench"
+    warmup_s = eng.warmup(level)
     log(f"bench: warmup {warmup_s:.1f}s "
         f"(persistent cache makes repeat runs fast)")
 
@@ -315,6 +344,8 @@ def run_single() -> None:
         f"{tok_s_chip:.0f} tok/s/chip; p50 TTFT {p50_ttft_ms:.0f} ms")
 
     qtag = f",{quantize}" if quantize else ""
+    if spec_k:
+        qtag += f",spec{spec_k}"
     print(json.dumps({
         "metric": f"paged_decode_throughput[{model}{qtag},B={batch},{platform}]",
         "value": round(tok_s_chip, 1),

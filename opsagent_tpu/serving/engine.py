@@ -491,6 +491,9 @@ class Engine:
     # pay for those.
     WARMUP_LEVELS: dict = {
         "bench": frozenset({"prefill", "sample", "decode_greedy"}),
+        "bench-spec": frozenset(
+            {"prefill", "sample", "decode_greedy", "spec"}
+        ),
         "sessions": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
             "decode_greedy",
