@@ -8,11 +8,12 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to three presets in isolated
+A plain `python bench.py` orchestrates up to four presets in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
 the guaranteed number), then the bench-8b int8 headline, then the
-BASELINE config-5 concurrent-sessions run. EVERY result line is printed
+BASELINE config-5 concurrent-sessions run, then a speculative-decoding
+overhead run. EVERY result line is printed
 and flushed the moment it exists (the driver kills this process at an
 unknown wall clock; an already-earned number must survive), and a
 combined headline line is printed last. If the default preset dies —
@@ -133,57 +134,62 @@ def run_orchestrated() -> None:
 
     Order: default preset (bench-1b on TPU, tiny-test elsewhere — the
     guaranteed number), then the bench-8b int8 headline, then the
-    BASELINE config-5 concurrent-sessions run; stages 2 and 3 only start
-    if the remaining budget plausibly covers them."""
+    BASELINE config-5 concurrent-sessions run, then a speculative-
+    decoding overhead run; stages 2-4 only start if the remaining budget
+    plausibly covers them. Mode/spec env vars are stripped from stages
+    they don't belong to, so an operator-set OPSAGENT_BENCH_SPEC cannot
+    contaminate the baseline stages."""
     budget = float(os.environ.get("OPSAGENT_BENCH_BUDGET", "850"))
     t_start = time.perf_counter()
 
     def remaining() -> float:
         return budget - (time.perf_counter() - t_start)
 
+    # None-valued entries REMOVE inherited vars (see _run_child).
+    base = {"OPSAGENT_BENCH_SPEC": None, "OPSAGENT_BENCH_MODE": None}
+
+    def stage(env_extra: dict, min_remaining: float, tag: str,
+              cap: float | None = None) -> dict | None:
+        """One budget-gated preset: run, flush its line immediately."""
+        if remaining() <= min_remaining:
+            log(f"bench: skipping {tag} ({remaining():.0f}s left)")
+            return None
+        timeout_s = remaining() - 10
+        if cap is not None:
+            timeout_s = min(cap, timeout_s)
+        r = _run_child({**base, **env_extra}, timeout_s, tag)
+        if r is not None:
+            print(json.dumps(r), flush=True)
+        return r
+
     stage1_cap = float(os.environ.get("OPSAGENT_BENCH_STAGE1_CAP", "390"))
-    r1 = _run_child({}, min(stage1_cap, remaining() - 10), "default")
+    r1 = stage({}, 0, "default", cap=stage1_cap)
     if r1 is None:
         # Device unreachable or preset wedged: a cpu-pinned child (no TPU
         # plugin) still proves the stack end to end and guarantees the
         # driver a parsed line.
         log("bench: default preset failed; falling back to cpu-pinned run")
-        r1 = _run_child(
+        r1 = stage(
             {**_cpu_env(), "OPSAGENT_BENCH_MODEL": "tiny-test"},
-            min(180.0, remaining() - 10), "cpu-fallback",
+            0, "cpu-fallback", cap=180.0,
         )
         if r1 is not None:
             r1.setdefault("extra", {})["note"] = (
                 "cpu fallback: tpu device unreachable during bench window"
             )
-    if r1 is not None:
-        print(json.dumps(r1), flush=True)
     platform = (r1 or {}).get("extra", {}).get("platform", "")
     headline = r1
 
-    r8b = None
-    if platform == "tpu" and remaining() > 420:
-        r8b = _run_child(
-            {"OPSAGENT_BENCH_MODEL": "bench-8b"}, remaining() - 10, "8b"
-        )
-        if r8b is not None:
-            print(json.dumps(r8b), flush=True)
-            headline = r8b
-    elif platform == "tpu":
-        log(f"bench: skipping 8b ({remaining():.0f}s left)")
-
-    rsess = None
-    if platform == "tpu" and remaining() > 240:
-        rsess = _run_child(
-            {"OPSAGENT_BENCH_MODE": "sessions",
-             "OPSAGENT_BENCH_MODEL": "bench-1b"},
-            remaining() - 10, "sessions",
-        )
-        if rsess is not None:
-            print(json.dumps(rsess), flush=True)
-    elif platform == "tpu":
-        log(f"bench: skipping sessions ({remaining():.0f}s left)")
-
+    on_tpu = platform == "tpu"
+    r8b = stage({"OPSAGENT_BENCH_MODEL": "bench-8b"}, 420, "8b") \
+        if on_tpu else None
+    if r8b is not None:
+        headline = r8b
+    rsess = stage(
+        {"OPSAGENT_BENCH_MODE": "sessions",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        240, "sessions",
+    ) if on_tpu else None
     # Speculative decoding (PERF.md plan item 3): same 1B preset with
     # prompt-lookup drafting on. With random weights and uniform-random
     # prompts acceptance is ~0, so value-vs-stage-1 measures the WORST
@@ -191,17 +197,11 @@ def run_orchestrated() -> None:
     # on re-emitted JSON scaffolding) needs trained weights — see
     # scripts/run_real_checkpoint.py.
     SPEC_K = 4
-    rspec = None
-    if platform == "tpu" and remaining() > 180:
-        rspec = _run_child(
-            {"OPSAGENT_BENCH_MODEL": "bench-1b",
-             "OPSAGENT_BENCH_SPEC": str(SPEC_K)},
-            remaining() - 10, "spec",
-        )
-        if rspec is not None:
-            print(json.dumps(rspec), flush=True)
-    elif platform == "tpu":
-        log(f"bench: skipping spec ({remaining():.0f}s left)")
+    rspec = stage(
+        {"OPSAGENT_BENCH_MODEL": "bench-1b",
+         "OPSAGENT_BENCH_SPEC": str(SPEC_K)},
+        180, "spec",
+    ) if on_tpu else None
 
     if headline is None:
         log("bench: no preset produced a number")
@@ -257,6 +257,10 @@ def run_single() -> None:
     # 128 prompt + 512 generated + slack for the decode pipeline's lookahead
     # (decode_block x (pipeline_depth + 1) tokens are pre-booked).
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
+    if os.environ.get("OPSAGENT_BENCH_MODE") == "sessions":
+        # Sessions measures full-stack concurrency; keep speculation out
+        # of it (its warmup level does not compile the spec program).
+        spec_k = 0
     cfg = EngineConfig(
         model=model,
         dtype=dtype,
