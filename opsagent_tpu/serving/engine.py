@@ -603,17 +603,35 @@ class Engine:
                 g for g in (True, False)
                 if ("decode_greedy" if g else "decode_sampled") in progs
             ]
-            for greedy in greedy_variants:
-                # Fresh arrays per call: carry args are donated.
+            def warm_pipeline(greedy: bool, fm=None, fd=None):
+                """TWO chained calls mirroring step_block's exact argument
+                structure (carry_fsm/ov_fsm always passed): the first
+                dispatch sees fresh host arrays, every later one sees the
+                previous dispatch's OUTPUTS as carries — different input
+                shardings, hence a second jit cache entry. Both must
+                compile here or the second real block pays XLA inside the
+                serving window."""
                 self._sample_key, sub = jax.random.split(self._sample_key)
-                toks, self.cache, _ = self._decode_pipeline_jit(
-                    self.params,
+                carry = (
                     jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
-                    jnp.zeros((B,), bool), sub,
-                    jnp.zeros((B,), bool), zi, zi, inactive, zi,
-                    self.cache, dropB, zf, zi, of,
-                    greedy=greedy,
+                    jnp.zeros((B,), bool), jnp.zeros((B,), jnp.int32), sub,
                 )
+                toks = None
+                for _ in range(2):
+                    c_tok, c_at, c_eos, c_fsm, c_key = carry
+                    toks, self.cache, carry = self._decode_pipeline_jit(
+                        self.params,
+                        c_tok, c_at, c_eos, c_key,
+                        jnp.zeros((B,), bool), zi, zi, inactive, zi,
+                        self.cache, dropB, zf, zi, of,
+                        greedy=greedy,
+                        fsm_mask=fm, fsm_dest=fd,
+                        carry_fsm=c_fsm, ov_fsm=zi,
+                    )
+                return toks
+
+            for greedy in greedy_variants:
+                toks = warm_pipeline(greedy)
             # Device-FSM decode variant, pre-specialized for the agent's
             # primary constraint (the ReAct ToolPrompt schema): the first
             # constrained request must not pay the dense-table build plus
@@ -627,33 +645,26 @@ class Engine:
                     if con.fsm.dense_tables() is not None:
                         fm, fd = self._fsm_device_tables(con.fsm)
                         for greedy in (True, False):
-                            self._sample_key, sub = jax.random.split(
-                                self._sample_key
-                            )
-                            toks, self.cache, _ = self._decode_pipeline_jit(
-                                self.params,
-                                jnp.zeros((B,), jnp.int32),
-                                jnp.zeros((B,), jnp.int32),
-                                jnp.zeros((B,), bool), sub,
-                                jnp.zeros((B,), bool), zi, zi, inactive, zi,
-                                self.cache, dropB, zf, zi, of,
-                                greedy=greedy,
-                                fsm_mask=fm, fsm_dest=fd,
-                                carry_fsm=zi, ov_fsm=zi,
-                            )
+                            toks = warm_pipeline(greedy, fm, fd)
                 except Exception:  # noqa: BLE001 - warmup is best-effort
                     log.exception("ToolPrompt FSM warmup failed (non-fatal)")
             if "spec" in progs and self.cfg.speculative_k > 0:
                 H = self.cfg.max_pages_per_seq * self.cfg.page_size
-                zh = jnp.zeros((B, H), jnp.int32)
-                toks, _, self.cache, _ = self._spec_pipeline_jit(
-                    self.params,
+                ov_hist = jnp.zeros((B, H), jnp.int32)
+                carry_s = (
                     jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
-                    jnp.zeros((B,), bool), zh,
-                    jnp.zeros((B,), bool), zi, zi,
-                    jnp.zeros((B, H), jnp.int32), inactive, zi,
-                    self.cache, dropB,
+                    jnp.zeros((B,), bool), jnp.zeros((B, H), jnp.int32),
                 )
+                for _ in range(2):
+                    c_tok, c_at, c_eos, c_hist = carry_s
+                    toks, _, self.cache, carry_out = self._spec_pipeline_jit(
+                        self.params,
+                        c_tok, c_at, c_eos, c_hist,
+                        jnp.zeros((B,), bool), zi, zi,
+                        ov_hist, inactive, zi,
+                        self.cache, dropB,
+                    )
+                    carry_s = carry_out
             self._carry = None  # warmup carries are throwaways
             self._hist = None
             # A real device->host pull: on async backends block_until_ready
