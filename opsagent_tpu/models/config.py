@@ -27,6 +27,17 @@ class MoEConfig:
     # norm_topk_prob=true with routed_scaling_factor=2.5.
     norm_topk_prob: bool = False
     routed_scaling_factor: float = 1.0
+    # Router scoring (HF scoring_func): "softmax" (DeepSeek-MoE/V2) or
+    # "sigmoid" (V3). Sigmoid scoring pairs with the noaux_tc topk_method:
+    # a per-expert e_score_correction_bias (loaded from the checkpoint)
+    # is added for SELECTION only; combine weights use the uncorrected
+    # sigmoid scores.
+    scoring_func: str = "softmax"
+    # Group-limited top-k (HF n_group/topk_group): experts partition into
+    # n_group groups; only the topk_group best groups (by the sum of each
+    # group's top-2 selection scores) are eligible. 1/1 disables.
+    n_group: int = 1
+    topk_group: int = 1
     # Grouped-dispatch policy. Below the token threshold (decode steps,
     # tiny batches) the all-experts scan runs instead: with T*k >= E every
     # expert's weights stream from HBM once either way, so the scan is
@@ -36,6 +47,27 @@ class MoEConfig:
     # not num_experts. 0 disables grouped dispatch entirely.
     grouped_dispatch_min_tokens: int = 512
     capacity_factor: float = 2.0
+
+
+@dataclass(frozen=True)
+class MLAConfig:
+    """Multi-head Latent Attention (DeepSeek-V2/V3): low-rank q and kv
+    projections with a decoupled per-head-SHARED RoPE part. Served here in
+    the uncompressed-cache form — k/v are materialized per head and ride
+    the standard paged cache (v zero-padded to the qk head dim so every
+    attention path is shared); the compressed-latent cache (kv_lora_rank
+    + rope dims per token) is a planned optimization. YaRN length scaling
+    (mscale) is not yet applied."""
+
+    q_lora_rank: int = 0           # 0 = full-rank q projection (V2-Lite)
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
 
 
 @dataclass(frozen=True)
@@ -55,10 +87,21 @@ class ModelConfig:
     max_position: int = 131072
     moe: Optional[MoEConfig] = None
     moe_layer_start: int = 0         # dense layers before the first MoE layer
+    # DeepSeek-V2/V3 Multi-head Latent Attention. Constraints (validated
+    # by models.llama.init_params): head_dim == mla.qk_head_dim and
+    # num_kv_heads == num_heads (MLA has no GQA; the latent IS the
+    # compression).
+    mla: Optional[MLAConfig] = None
 
     @property
     def head_dim_(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def rope_dim_(self) -> int:
+        """Dims RoPE rotates: the decoupled rope part under MLA, the whole
+        head otherwise."""
+        return self.mla.qk_rope_head_dim if self.mla else self.head_dim_
 
     @property
     def q_size(self) -> int:
@@ -99,7 +142,9 @@ TINY_TEST = _register(
         num_heads=4,
         num_kv_heads=2,
         rope_theta=10000.0,
-        max_position=2048,
+        # Generous window: the byte tokenizer spends ~3k tokens on the
+        # agent system prompt, and admission now ENFORCES max_position.
+        max_position=16384,
     )
 )
 
@@ -226,6 +271,105 @@ DEEPSEEK_MOE_16B = _register(
             expert_intermediate_size=1408,
         ),
         moe_layer_start=1,
+    )
+)
+
+# DeepSeek-V2-Lite (16B-class MLA + MoE; HF deepseek_v2 arch): full-rank
+# q (q_lora_rank null in the HF config), compressed kv. Reference HF
+# config fields mirrored 1:1.
+DEEPSEEK_V2_LITE = _register(
+    ModelConfig(
+        name="deepseek-v2-lite",
+        vocab_size=102400,
+        hidden_size=2048,
+        intermediate_size=10944,
+        num_layers=27,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=192,               # qk_nope (128) + qk_rope (64)
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        # The HF checkpoint extends to 160k via YaRN rope scaling, which
+        # is not implemented yet (neither the per-dim interpolation nor
+        # the mscale softmax-scale factor); admit only the NATIVE window
+        # so long requests fail loudly instead of degenerating.
+        max_position=4096,
+        moe=MoEConfig(
+            num_experts=64,
+            num_experts_per_token=6,
+            num_shared_experts=2,
+            expert_intermediate_size=1408,
+        ),
+        moe_layer_start=1,
+        mla=MLAConfig(
+            q_lora_rank=0,
+            kv_lora_rank=512,
+            qk_nope_head_dim=128,
+            qk_rope_head_dim=64,
+            v_head_dim=128,
+        ),
+    )
+)
+
+# DeepSeek-V3 (671B total / 37B active; BASELINE config 3): MLA with
+# low-rank q, 256 routed experts top-8 + 1 shared, SIGMOID router scoring
+# with the noaux_tc selection bias and group-limited top-k (n_group 8,
+# topk_group 4), norm_topk_prob and routed scaling 2.5 per the HF config.
+DEEPSEEK_V3 = _register(
+    ModelConfig(
+        name="deepseek-v3",
+        vocab_size=129280,
+        hidden_size=7168,
+        intermediate_size=18432,
+        num_layers=61,
+        num_heads=128,
+        num_kv_heads=128,
+        head_dim=192,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        # YaRN (factor 40 -> 160k) not yet implemented: native window only.
+        max_position=4096,
+        moe=MoEConfig(
+            num_experts=256,
+            num_experts_per_token=8,
+            num_shared_experts=1,
+            expert_intermediate_size=2048,
+            norm_topk_prob=True,
+            routed_scaling_factor=2.5,
+            scoring_func="sigmoid",
+            n_group=8,
+            topk_group=4,
+        ),
+        moe_layer_start=3,
+        mla=MLAConfig(
+            q_lora_rank=1536,
+            kv_lora_rank=512,
+            qk_nope_head_dim=128,
+            qk_rope_head_dim=64,
+            v_head_dim=128,
+        ),
+    )
+)
+
+TINY_MLA = _register(
+    ModelConfig(
+        name="tiny-mla",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=4,
+        head_dim=24,                # 16 nope + 8 rope
+        rope_theta=10000.0,
+        max_position=2048,
+        mla=MLAConfig(
+            q_lora_rank=32,
+            kv_lora_rank=32,
+            qk_nope_head_dim=16,
+            qk_rope_head_dim=8,
+            v_head_dim=16,
+        ),
     )
 )
 

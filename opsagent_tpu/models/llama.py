@@ -57,6 +57,41 @@ def _norm01(k, shape, fan_in, dtype):
     ).astype(dtype)
 
 
+def _mla_attn_block(cfg: ModelConfig, L: int, ks, dtype, big) -> Params:
+    """Multi-head Latent Attention projections (DeepSeek-V2/V3; HF
+    modeling_deepseek naming in comments). Validated invariants: head_dim
+    == qk_head_dim, no GQA. ``wo`` rows are laid out per head over the
+    PADDED head dim (v is zero-padded from v_head_dim to qk_head_dim so
+    the cache/attention paths stay shared); the pad rows multiply zeros,
+    so their values are irrelevant — the loader zeroes them."""
+    m = cfg.mla
+    d = cfg.hidden_size
+    H = cfg.num_heads
+    dn, dr, dv = m.qk_nope_head_dim, m.qk_rope_head_dim, m.v_head_dim
+    dq = dn + dr
+    if cfg.head_dim_ != dq:
+        raise ValueError(
+            f"mla: head_dim={cfg.head_dim_} must equal qk_head_dim={dq}"
+        )
+    if cfg.num_kv_heads != H:
+        raise ValueError("mla: num_kv_heads must equal num_heads (no GQA)")
+    block: Params = {"attn_norm": jnp.ones((L, d), dtype)}
+    if m.q_lora_rank:
+        block["wdq"] = big(next(ks), (L, d, m.q_lora_rank), d)     # q_a_proj
+        block["q_norm"] = jnp.ones((L, m.q_lora_rank), dtype)      # q_a_layernorm
+        block["wuq"] = big(next(ks), (L, m.q_lora_rank, H * dq), m.q_lora_rank)  # q_b_proj
+    else:
+        block["wq"] = big(next(ks), (L, d, H * dq), d)             # q_proj
+    rkv = m.kv_lora_rank
+    block["wdkv"] = big(next(ks), (L, d, rkv), d)   # kv_a_proj_with_mqa[:rkv]
+    block["wkr"] = big(next(ks), (L, d, dr), d)     # kv_a_proj_with_mqa[rkv:]
+    block["kv_norm"] = jnp.ones((L, rkv), dtype)    # kv_a_layernorm
+    block["wukv"] = big(next(ks), (L, rkv, H * (dn + dv)), rkv)    # kv_b_proj
+    block["wo"] = big(next(ks), (L, H * dq, d), H * dv)            # o_proj, padded rows
+    block["mlp_norm"] = jnp.ones((L, d), dtype)
+    return block
+
+
 def _build_tree(cfg: ModelConfig, ks, dtype, big, dense) -> Params:
     """THE param-tree structure, shared by every initializer so it cannot
     drift from ``param_specs``. ``big(key, shape, fan_in)`` makes the large
@@ -68,6 +103,8 @@ def _build_tree(cfg: ModelConfig, ks, dtype, big, dense) -> Params:
     Ld, Lm = _layer_split(cfg)
 
     def attn_block(L: int) -> Params:
+        if cfg.mla is not None:
+            return _mla_attn_block(cfg, L, ks, dtype, big)
         block: Params = {
             "attn_norm": jnp.ones((L, d), dtype),
             "wq": big(next(ks), (L, d, q), d),
@@ -98,6 +135,10 @@ def _build_tree(cfg: ModelConfig, ks, dtype, big, dense) -> Params:
         moe_layers = attn_block(Lm)
         # Router stays f32: tiny, and top-k is precision-sensitive.
         moe_layers["router"] = dense(next(ks), (Lm, d, E), d, jnp.float32)
+        if m.scoring_func == "sigmoid":
+            # noaux_tc selection bias (zero-init; loaded from real
+            # checkpoints' e_score_correction_bias).
+            moe_layers["router_bias"] = jnp.zeros((Lm, E), jnp.float32)
         moe_layers["eg"] = big(next(ks), (Lm, E, d, fe), d)
         moe_layers["eu"] = big(next(ks), (Lm, E, d, fe), d)
         moe_layers["ed"] = big(next(ks), (Lm, E, fe, d), fe)
@@ -190,6 +231,27 @@ def init_params_random_int8(
 
 
 def _attn_block_specs(cfg: ModelConfig) -> Params:
+    if cfg.mla is not None:
+        # Megatron MLA: the per-head output dims of wuq/wukv are
+        # column-parallel (heads shard over tp), wo is row-parallel; the
+        # low-rank down-projections and the shared rope key are small and
+        # replicated.
+        block = {
+            "attn_norm": P(None, None),
+            "wdkv": P(None, None, None),
+            "wkr": P(None, None, None),
+            "kv_norm": P(None, None),
+            "wukv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+        }
+        if cfg.mla.q_lora_rank:
+            block["wdq"] = P(None, None, None)
+            block["q_norm"] = P(None, None)
+            block["wuq"] = P(None, None, "tp")
+        else:
+            block["wq"] = P(None, None, "tp")
+        return block
     block = {
         "attn_norm": P(None, None),
         "wq": P(None, None, "tp"),
@@ -233,6 +295,8 @@ def param_specs(cfg: ModelConfig) -> Params:
     Ld, Lm = _layer_split(cfg)
     if Lm:
         moe_layers = _attn_block_specs(cfg)
+        if cfg.moe.scoring_func == "sigmoid":
+            moe_layers["router_bias"] = P(None, None)
         moe_layers.update(
             {
                 "router": P(None, None, None),
@@ -359,6 +423,60 @@ def _qkv(
     )
 
 
+def _qkv_mla(
+    x: jax.Array, lp: Params, cfg: ModelConfig, cos, sin
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """MLA q/k/v with decoupled RoPE (DeepSeek-V2/V3):
+
+    - q: (optionally low-rank) projection to H x (nope + rope) dims; RoPE
+      rotates only the rope part.
+    - kv: one low-rank latent c_kv plus a per-head-SHARED roped key part
+      computed straight from x; up-projection expands the normed latent
+      to per-head k_nope and v.
+    - v is zero-padded to the qk head dim so the shared paged cache and
+      attention paths need no second head-dim; wo's matching rows are
+      padding (they multiply zeros).
+    """
+    m = cfg.mla
+    B, S, _ = x.shape
+    H = cfg.num_heads
+    dn, dr, dv = m.qk_nope_head_dim, m.qk_rope_head_dim, m.v_head_dim
+    dq = dn + dr
+    if m.q_lora_rank:
+        cq = rms_norm(_mm(x, lp["wdq"]), lp["q_norm"], cfg.rms_norm_eps)
+        q = _mm(cq, lp["wuq"])
+    else:
+        q = _mm(x, lp["wq"])
+    q = q.reshape(B, S, H, dq)
+    q = jnp.concatenate(
+        [q[..., :dn], apply_rope(q[..., dn:], cos, sin)], axis=-1
+    )
+    ckv = rms_norm(_mm(x, lp["wdkv"]), lp["kv_norm"], cfg.rms_norm_eps)
+    k_rope = apply_rope(
+        _mm(x, lp["wkr"]).reshape(B, S, 1, dr), cos, sin
+    )
+    kv = _mm(ckv, lp["wukv"]).reshape(B, S, H, dn + dv)
+    k = jnp.concatenate(
+        [kv[..., :dn], jnp.broadcast_to(k_rope, (B, S, H, dr))], axis=-1
+    )
+    v = jnp.concatenate(
+        [kv[..., dn:], jnp.zeros((B, S, H, dq - dv), kv.dtype)], axis=-1
+    )
+    return q, k, v
+
+
+def _qkv_rope(
+    x: jax.Array, lp: Params, cfg: ModelConfig, cos, sin
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """q/k/v with RoPE applied, dispatched on the attention family. The
+    rope tables must be built with ``cfg.rope_dim_`` (the decoupled rope
+    part under MLA, the full head otherwise)."""
+    if cfg.mla is not None:
+        return _qkv_mla(x, lp, cfg, cos, sin)
+    q, k, v = _qkv(x, lp, cfg)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
 def _mlp(x: jax.Array, lp: Params) -> jax.Array:
     return _mm(jax.nn.silu(_mm(x, lp["wg"])) * _mm(x, lp["wu"]), lp["wd"])
 
@@ -396,13 +514,37 @@ def _moe_mlp(
     E, k = m.num_experts, m.num_experts_per_token
     T = h.shape[0] * h.shape[1]
     router_logits = (h.astype(jnp.float32) @ lp["router"])          # [B,S,E]
-    probs = jax.nn.softmax(router_logits, axis=-1)
-    vals, idx = jax.lax.top_k(probs, k)                             # [B,S,k]
+    # Router scoring per the checkpoint's HF config: softmax (DeepSeek-
+    # MoE/V2) or sigmoid with the noaux_tc selection bias (V3). The bias
+    # steers SELECTION only; combine weights come from the raw scores.
+    if m.scoring_func == "sigmoid":
+        probs = jax.nn.sigmoid(router_logits)
+    else:
+        probs = jax.nn.softmax(router_logits, axis=-1)
+    select = probs
+    if "router_bias" in lp:
+        select = select + lp["router_bias"]
+    if m.n_group > 1:
+        # Group-limited top-k: rank groups by the sum of each group's top-2
+        # selection scores; experts outside the best topk_group groups are
+        # ineligible.
+        Bd, Sd = select.shape[:2]
+        g = select.reshape(Bd, Sd, m.n_group, E // m.n_group)
+        group_score = jnp.sum(jax.lax.top_k(g, 2)[0], axis=-1)      # [B,S,G]
+        _, keep_idx = jax.lax.top_k(group_score, m.topk_group)
+        keep = jnp.sum(
+            jax.nn.one_hot(keep_idx, m.n_group, dtype=select.dtype), axis=-2
+        )                                                           # [B,S,G]
+        select = jnp.where(
+            keep[..., None] > 0, g, -jnp.inf
+        ).reshape(Bd, Sd, E)
+    _, idx = jax.lax.top_k(select, k)                               # [B,S,k]
+    vals = jnp.take_along_axis(probs, idx, axis=-1)
     # Combine-weight semantics follow the checkpoint's HF config: DeepSeek-
     # MoE-16B/V2-Lite use raw top-k softmax probs (norm_topk_prob=false);
     # V3 renormalizes among the selected and scales by 2.5.
     if m.norm_topk_prob:
-        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+        vals = vals / (jnp.sum(vals, axis=-1, keepdims=True) + 1e-20)
     if m.routed_scaling_factor != 1.0:
         vals = vals * m.routed_scaling_factor
     sel = jnp.sum(jax.nn.one_hot(idx, E, dtype=probs.dtype), axis=-2)  # [B,S,E]
@@ -574,15 +716,13 @@ def prefill(
     stay on the pjit-partitioned scatter either way."""
     B, S = tokens.shape
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
-    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)
     start = jnp.zeros((B,), jnp.int32)
     attn_op = prefill_attn or causal_prefill_attention
 
     def attn_fn(h, lp, kc, vc, li):
-        q, k, v = _qkv(h, lp, cfg)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
         kc, vc = write_kv_pages(
             kc, vc, k, v, page_table, start, valid_len=lengths, layer=li
         )
@@ -612,13 +752,11 @@ def prefill_with_prefix(
     (last-tail-position logits [B, V], updated cache)."""
     B, S = tokens.shape
     positions = start[:, None] + jnp.arange(S)[None, :]
-    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)
 
     def attn_fn(h, lp, kc, vc, li):
-        q, k, v = _qkv(h, lp, cfg)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
         kc, vc = write_kv_pages(
             kc, vc, k, v, page_table, start, valid_len=lengths, layer=li
         )
@@ -657,13 +795,11 @@ def verify_step(
     forward, the whole point of speculation)."""
     B, S = tokens.shape
     positions = start[:, None] + jnp.arange(S)[None, :]
-    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)
 
     def attn_fn(h, lp, kc, vc, li):
-        q, k, v = _qkv(h, lp, cfg)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
         kc, vc = write_kv_pages(
             kc, vc, k, v, page_table, start, valid_len=valid, layer=li
         )
@@ -694,14 +830,12 @@ def decode_step(
     updated cache)."""
     B = tokens.shape[0]
     positions = lengths[:, None]                       # [B, 1]
-    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
     x = params["embed"][tokens[:, None]].astype(dtype)  # [B, 1, D]
     valid = active.astype(jnp.int32)                   # [B] 1 new token if active
 
     def attn_fn(h, lp, kc, vc, li):
-        q, k, v = _qkv(h, lp, cfg)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
         kc, vc = write_kv_pages(
             kc, vc, k, v, page_table, lengths, valid_len=valid, layer=li
         )
@@ -740,14 +874,12 @@ def forward_full(
     (zero for dense models)."""
     B, S = tokens.shape
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
-    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)
     attn_op = prefill_attn or causal_prefill_attention
 
     def attn_fn(h, lp, kc, vc, li):
-        q, k, v = _qkv(h, lp, cfg)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
         attn = attn_op(q, k, v)
         return attn.reshape(B, S, -1), kc, vc
 

@@ -71,12 +71,130 @@ def _stack_linear(tensors, name_fmt: str, ids: list[int], dtype) -> jnp.ndarray:
     return jnp.asarray(np.stack(mats), dtype=dtype)
 
 
+def _rope_interleave_to_halfsplit(dr: int) -> np.ndarray:
+    """Column permutation mapping HF DeepSeek's INTERLEAVED rope pair
+    layout (dims 2i/2i+1 rotate together; modeling_deepseek de-interleaves
+    activations with view(.., d//2, 2).transpose before rotate_half) onto
+    this framework's half-split ``apply_rope`` convention (dim j pairs
+    with j + d/2). Permuting the projection's output columns once at load
+    is exactly equivalent to HF's runtime de-interleave."""
+    return np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])
+
+
+def _load_mla_attn_block(
+    tensors, cfg: ModelConfig, layer_ids: list[int], dtype
+) -> dict[str, Any]:
+    """MLA attention weights (HF deepseek_v2/v3 naming) -> this
+    framework's layout (models/llama._mla_attn_block):
+
+    - ``kv_a_proj_with_mqa`` rows split into the kv latent (wdkv) and the
+      shared rope key (wkr);
+    - rope-part columns (of q and wkr) permuted from HF's interleaved
+      pair order to the half-split order ``apply_rope`` expects;
+    - ``o_proj`` columns (HF [d, H*dv]) expand to PADDED per-head rows
+      [H*(dn+dr), d] — the pad rows multiply the v zero-padding and are
+      zeroed here.
+    """
+    m = cfg.mla
+    d = cfg.hidden_size
+    H = cfg.num_heads
+    dn, dr, dv = m.qk_nope_head_dim, m.qk_rope_head_dim, m.v_head_dim
+    dq = dn + dr
+    rkv = m.kv_lora_rank
+
+    def vector(name_fmt: str) -> jnp.ndarray:
+        vecs = [_get(tensors, name_fmt.format(i)) for i in layer_ids]
+        return jnp.asarray(np.stack(vecs), dtype=dtype)
+
+    def linear(name_fmt: str) -> jnp.ndarray:
+        return _stack_linear(tensors, name_fmt, layer_ids, dtype)
+
+    if not layer_ids:
+        block: dict[str, Any] = {
+            "attn_norm": jnp.zeros((0, d), dtype),
+            "wdkv": jnp.zeros((0, d, rkv), dtype),
+            "wkr": jnp.zeros((0, d, dr), dtype),
+            "kv_norm": jnp.zeros((0, rkv), dtype),
+            "wukv": jnp.zeros((0, rkv, H * (dn + dv)), dtype),
+            "wo": jnp.zeros((0, H * dq, d), dtype),
+            "mlp_norm": jnp.zeros((0, d), dtype),
+        }
+        if m.q_lora_rank:
+            block["wdq"] = jnp.zeros((0, d, m.q_lora_rank), dtype)
+            block["q_norm"] = jnp.zeros((0, m.q_lora_rank), dtype)
+            block["wuq"] = jnp.zeros((0, m.q_lora_rank, H * dq), dtype)
+        else:
+            block["wq"] = jnp.zeros((0, d, H * dq), dtype)
+        return block
+
+    perm = _rope_interleave_to_halfsplit(dr)
+
+    def fix_q_rope(w: np.ndarray) -> np.ndarray:
+        """Permute each head's rope columns of a [in, H*dq] q projection."""
+        w = w.reshape(w.shape[0], H, dq)
+        return np.concatenate(
+            [w[..., :dn], w[..., dn:][..., perm]], axis=-1
+        ).reshape(w.shape[0], H * dq)
+
+    block = {
+        "attn_norm": vector("model.layers.{}.input_layernorm.weight"),
+        "kv_norm": vector("model.layers.{}.self_attn.kv_a_layernorm.weight"),
+        "wukv": linear("model.layers.{}.self_attn.kv_b_proj.weight"),
+        "mlp_norm": vector("model.layers.{}.post_attention_layernorm.weight"),
+    }
+    def q_linear(hf_name: str) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([
+                fix_q_rope(
+                    _get(
+                        tensors,
+                        f"model.layers.{i}.self_attn.{hf_name}.weight",
+                    ).T
+                )
+                for i in layer_ids
+            ]),
+            dtype=dtype,
+        )
+
+    if m.q_lora_rank:
+        block["wdq"] = linear("model.layers.{}.self_attn.q_a_proj.weight")
+        block["q_norm"] = vector(
+            "model.layers.{}.self_attn.q_a_layernorm.weight"
+        )
+        block["wuq"] = q_linear("q_b_proj")
+    else:
+        block["wq"] = q_linear("q_proj")
+    # kv_a_proj_with_mqa: HF [rkv + dr, d] -> ours [d, rkv] + [d, dr]
+    # (rope columns permuted to half-split order).
+    wdkv, wkr = [], []
+    for i in layer_ids:
+        w = _get(
+            tensors, f"model.layers.{i}.self_attn.kv_a_proj_with_mqa.weight"
+        ).T  # [d, rkv + dr]
+        wdkv.append(w[:, :rkv])
+        wkr.append(w[:, rkv:][:, perm])
+    block["wdkv"] = jnp.asarray(np.stack(wdkv), dtype=dtype)
+    block["wkr"] = jnp.asarray(np.stack(wkr), dtype=dtype)
+    # o_proj: HF [d, H*dv] -> ours transposed [H*dv, d], expanded to
+    # [H*(dn+dr), d] with zero pad rows per head.
+    wo = []
+    for i in layer_ids:
+        w = _get(tensors, f"model.layers.{i}.self_attn.o_proj.weight").T
+        w = w.reshape(H, dv, d)
+        pad = np.zeros((H, dq - dv, d), w.dtype)
+        wo.append(np.concatenate([w, pad], axis=1).reshape(H * dq, d))
+    block["wo"] = jnp.asarray(np.stack(wo), dtype=dtype)
+    return block
+
+
 def _load_attn_block(
     tensors, cfg: ModelConfig, layer_ids: list[int], dtype
 ) -> dict[str, Any]:
     """Attention weights + norms for an explicit list of HF layer indices,
     stacked in that order. An empty id list (e.g. moe_layer_start=0) yields
     zero-length stacks matching init_params' shapes."""
+    if cfg.mla is not None:
+        return _load_mla_attn_block(tensors, cfg, layer_ids, dtype)
     d, q, kv = cfg.hidden_size, cfg.q_size, cfg.kv_size
     if not layer_ids:
         block = {
@@ -188,6 +306,18 @@ def load_checkpoint(
                 "ed": experts("down_proj"),
             }
         )
+        if cfg.moe.scoring_func == "sigmoid":
+            # V3 noaux_tc selection bias (HF e_score_correction_bias).
+            moe_layers["router_bias"] = jnp.asarray(
+                np.stack([
+                    _get(
+                        tensors,
+                        f"model.layers.{i}.mlp.gate.e_score_correction_bias",
+                    )
+                    for i in moe_ids
+                ]),
+                dtype=jnp.float32,
+            )
         if cfg.moe.num_shared_experts:
             moe_layers["sg"] = linear_ids(
                 "model.layers.{}.mlp.shared_experts.gate_proj.weight", moe_ids
@@ -266,18 +396,96 @@ def _dump_block(
             flat[fmt.format(i + layer_offset)] = np.ascontiguousarray(mat)
 
 
-def save_checkpoint(path: str, params: dict[str, Any]) -> None:
+def _dump_mla_block(
+    flat: dict[str, np.ndarray],
+    block: dict[str, Any],
+    layer_offset: int,
+    cfg: ModelConfig,
+) -> None:
+    """Inverse of ``_load_mla_attn_block``: recombine wdkv/wkr into
+    kv_a_proj_with_mqa and strip wo's pad rows back to [d, H*dv]."""
+    m = cfg.mla
+    H = cfg.num_heads
+    dn, dr, dv = m.qk_nope_head_dim, m.qk_rope_head_dim, m.v_head_dim
+    dq = dn + dr
+    L = block["mlp_norm"].shape[0]
+    name_map = {
+        "attn_norm": "model.layers.{}.input_layernorm.weight",
+        "mlp_norm": "model.layers.{}.post_attention_layernorm.weight",
+        "kv_norm": "model.layers.{}.self_attn.kv_a_layernorm.weight",
+        "wukv": "model.layers.{}.self_attn.kv_b_proj.weight",
+        "wdq": "model.layers.{}.self_attn.q_a_proj.weight",
+        "q_norm": "model.layers.{}.self_attn.q_a_layernorm.weight",
+    }
+    _dump_block(flat, block, name_map, layer_offset)
+    # Rope columns back to HF's interleaved order (inverse of the load
+    # permutation).
+    inv = np.argsort(_rope_interleave_to_halfsplit(dr))
+
+    def unfix_q_rope(w: np.ndarray) -> np.ndarray:
+        w = w.reshape(w.shape[0], H, dq)
+        return np.concatenate(
+            [w[..., :dn], w[..., dn:][..., inv]], axis=-1
+        ).reshape(w.shape[0], H * dq)
+
+    q_key, q_name = (
+        ("wuq", "q_b_proj") if "wuq" in block else ("wq", "q_proj")
+    )
+    qw = np.asarray(block[q_key].astype(jnp.float32))
+    wdkv = np.asarray(block["wdkv"].astype(jnp.float32))
+    wkr = np.asarray(block["wkr"].astype(jnp.float32))
+    wo = np.asarray(block["wo"].astype(jnp.float32))
+    for i in range(L):
+        li = i + layer_offset
+        flat[f"model.layers.{li}.self_attn.{q_name}.weight"] = (
+            np.ascontiguousarray(unfix_q_rope(qw[i]).T)
+        )
+        flat[f"model.layers.{li}.self_attn.kv_a_proj_with_mqa.weight"] = (
+            np.ascontiguousarray(
+                np.concatenate([wdkv[i], wkr[i][:, inv]], axis=1).T
+            )
+        )
+        unpadded = wo[i].reshape(H, dq, -1)[:, :dv].reshape(H * dv, -1)
+        flat[f"model.layers.{li}.self_attn.o_proj.weight"] = (
+            np.ascontiguousarray(unpadded.T)
+        )
+
+
+def save_checkpoint(
+    path: str, params: dict[str, Any], cfg: ModelConfig | None = None
+) -> None:
     """Write params back out as a single HF-style safetensors file (testing
     and fine-tune export). MoE stacks round-trip through the DeepSeek naming
-    scheme ``load_checkpoint`` reads."""
+    scheme ``load_checkpoint`` reads; MLA models additionally need ``cfg``
+    (the pad/split geometry is not recoverable from shapes alone)."""
     from safetensors.numpy import save_file
 
     flat: dict[str, np.ndarray] = {}
-    Ld = params["layers"]["wq"].shape[0]
-    _dump_block(flat, params["layers"], {**_ATTN_NAME_MAP, **_DENSE_MLP_NAME_MAP}, 0)
+    Ld = params["layers"]["mlp_norm"].shape[0]
+    mla = cfg.mla if cfg is not None else None
+    if "wdkv" in params["layers"] and mla is None:
+        raise ValueError("saving an MLA checkpoint requires cfg")
+    if mla is not None:
+        _dump_mla_block(flat, params["layers"], 0, cfg)
+        _dump_block(flat, params["layers"], _DENSE_MLP_NAME_MAP, 0)
+    else:
+        _dump_block(
+            flat, params["layers"],
+            {**_ATTN_NAME_MAP, **_DENSE_MLP_NAME_MAP}, 0,
+        )
     if "moe_layers" in params:
         moe = params["moe_layers"]
-        _dump_block(flat, moe, {**_ATTN_NAME_MAP, **_SHARED_NAME_MAP}, Ld)
+        if mla is not None:
+            _dump_mla_block(flat, moe, Ld, cfg)
+            _dump_block(flat, moe, _SHARED_NAME_MAP, Ld)
+        else:
+            _dump_block(flat, moe, {**_ATTN_NAME_MAP, **_SHARED_NAME_MAP}, Ld)
+        if "router_bias" in moe:
+            rb = np.asarray(moe["router_bias"].astype(jnp.float32))
+            for i in range(rb.shape[0]):
+                flat[
+                    f"model.layers.{i + Ld}.mlp.gate.e_score_correction_bias"
+                ] = np.ascontiguousarray(rb[i])
         router = np.asarray(moe["router"].astype(jnp.float32))
         for i in range(router.shape[0]):
             flat[f"model.layers.{i + Ld}.mlp.gate.weight"] = (

@@ -73,7 +73,10 @@ def quantize_weight(w: jax.Array) -> QuantizedLinear:
 # f32 router stay exact (tiny, and routing is precision-sensitive).
 _QUANT_KEYS = frozenset(
     {"wq", "wk", "wv", "wo", "wg", "wu", "wd",
-     "eg", "eu", "ed", "sg", "su", "sd", "lm_head"}
+     "eg", "eu", "ed", "sg", "su", "sd", "lm_head",
+     # MLA projections (models/llama._mla_attn_block); the low-rank
+     # norms stay exact like other norm vectors.
+     "wdq", "wuq", "wdkv", "wkr", "wukv"}
 )
 
 

@@ -121,11 +121,7 @@ def make_pipeline_loss(
         mb, S = x.shape[:2]
 
         def attn_fn(h, lp, kc, vc, li):
-            q, k, v = llama._qkv(h, lp, cfg)
-            from ..ops.rope import apply_rope
-
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
+            q, k, v = llama._qkv_rope(h, lp, cfg, cos, sin)
             from ..ops.attention import causal_prefill_attention
 
             attn = causal_prefill_attention(q, k, v)
@@ -152,7 +148,7 @@ def make_pipeline_loss(
         positions = jnp.arange(S)[None, :].repeat(mb, axis=0)
         from ..ops.rope import rope_table
 
-        cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)
+        cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
 
         # Embedding is replicated over pp: every stage computes the same
         # xs, only stage 0's enters the pipeline (the where below).

@@ -316,6 +316,15 @@ class Engine:
         from ..ops.attention import paged_attention_backend
 
         self.attn_impl = paged_attention_backend()
+        if self.model_cfg.mla is not None and self.attn_impl != "xla":
+            # MLA's qk head dim (nope+rope, e.g. 192) breaks the Pallas
+            # kernels' last-dim tiling assumptions; the gather path is
+            # shape-agnostic.
+            log.info(
+                "mla model: forcing xla paged attention (was %s)",
+                self.attn_impl,
+            )
+            self.attn_impl = "xla"
         log.info(
             "paged decode attention impl: %s (tp=%d%s)",
             self.attn_impl, tp,
@@ -727,6 +736,23 @@ class Engine:
         n = len(prompt_ids)
         if n == 0:
             raise InvalidRequest("empty prompt")
+        if n >= self.model_cfg.max_position:
+            # Positions past the model's rope window produce degenerate
+            # attention (e.g. the DeepSeek presets clamp to the native
+            # pre-YaRN window); fail the request loudly instead.
+            raise InvalidRequest(
+                f"prompt of {n} tokens exceeds the model's "
+                f"{self.model_cfg.max_position}-position context window"
+            )
+        if n + sampling.max_tokens > self.model_cfg.max_position:
+            # Decode must not run positions past the window either: clamp
+            # the generation budget (OpenAI-style context-limit behavior —
+            # the request finishes with reason "length" at the window).
+            from dataclasses import replace as _dc_replace
+
+            sampling = _dc_replace(
+                sampling, max_tokens=self.model_cfg.max_position - n
+            )
         with self.lock:
             # Prefix cache: reuse full pages of the prompt MINUS its last
             # token (at least one tail token must be prefilled to produce

@@ -1,0 +1,221 @@
+"""Multi-head Latent Attention (DeepSeek-V2/V3 family, models/llama.py
+MLA paths): decode/prefill consistency against the all-positions oracle,
+HF-name checkpoint roundtrip, tensor parallelism, and serving.
+
+MLA is served in the uncompressed-cache form: k/v materialized per head,
+v zero-padded to the qk head dim so the shared paged-cache machinery is
+untouched (see config.MLAConfig docstring).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.models import llama
+from opsagent_tpu.models.config import get_config_preset
+
+CFG = get_config_preset("tiny-mla")
+DTYPE = jnp.float32
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0), dtype=DTYPE)
+
+
+def test_forward_shapes_and_finite(params):
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 8), 0, CFG.vocab_size
+    )
+    logits = llama.forward_full(params, CFG, tokens, dtype=DTYPE)
+    assert logits.shape == (2, 8, CFG.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_decode_chain_matches_forward_full(params):
+    """Prefill, then teacher-force decode steps; every step's logits must
+    match the all-at-once causal forward — proving the roped shared-key /
+    padded-v cache layout reproduces MLA attention exactly."""
+    S_total, S_prompt = 10, 4
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(6), (1, S_total), 0, CFG.vocab_size
+    )
+    full = llama.forward_full(params, CFG, tokens, dtype=DTYPE)
+
+    cache = llama.make_cache(CFG, num_pages=8, page_size=4, dtype=DTYPE)
+    table = jnp.array([[2, 5, 7]], jnp.int32)
+    logits, cache = llama.prefill(
+        params, CFG, tokens[:, :S_prompt], jnp.array([S_prompt]),
+        cache, table, dtype=DTYPE,
+    )
+    np.testing.assert_allclose(
+        logits[0], full[0, S_prompt - 1], rtol=2e-4, atol=2e-4
+    )
+    for t in range(S_prompt, S_total):
+        logits, cache = llama.decode_step(
+            params, CFG, tokens[:, t], jnp.array([t]), cache, table,
+            active=jnp.array([True]), dtype=DTYPE,
+        )
+        np.testing.assert_allclose(
+            logits[0], full[0, t], rtol=3e-4, atol=3e-4,
+            err_msg=f"decode step at position {t}",
+        )
+
+
+def test_checkpoint_roundtrip(tmp_path, params):
+    """save_checkpoint (HF deepseek naming: kv_a_proj_with_mqa recombined,
+    o_proj unpadded) -> load_checkpoint -> identical logits."""
+    from opsagent_tpu.models.loader import load_checkpoint, save_checkpoint
+
+    ckpt = tmp_path / "model.safetensors"
+    save_checkpoint(str(ckpt), params, cfg=CFG)
+    loaded = load_checkpoint(str(ckpt), CFG, dtype=DTYPE)
+    tokens = jnp.array([[1, 2, 3, 4, 5]], jnp.int32)
+    l1 = llama.forward_full(params, CFG, tokens, dtype=DTYPE)
+    l2 = llama.forward_full(loaded, CFG, tokens, dtype=DTYPE)
+    np.testing.assert_allclose(
+        np.asarray(l1), np.asarray(l2), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_tp_sharded_prefill_matches_single_device(params):
+    """tp=4 (heads shard 4 ways; wuq/wukv column-parallel, wo
+    row-parallel) must be numerically equivalent to unsharded."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from opsagent_tpu.parallel.mesh import make_mesh, shard_params
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (2, 8), 0, CFG.vocab_size
+    )
+    ref = llama.forward_full(params, CFG, tokens, dtype=DTYPE)
+
+    mesh = make_mesh(tp=4, dp=2, sp=1)
+    sharded = shard_params(params, llama.param_specs(CFG), mesh)
+    with mesh:
+        out = jax.jit(
+            lambda p, t: llama.forward_full(p, CFG, t, dtype=DTYPE),
+            in_shardings=(None, NamedSharding(mesh, P("dp"))),
+        )(sharded, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_engine_serves_mla(tmp_path):
+    """The serving engine generates from an MLA model (attention backend
+    forced to the shape-agnostic xla gather) and greedy generation is
+    deterministic across engines."""
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    outs = []
+    for _ in range(2):
+        eng = Engine(EngineConfig(
+            model="tiny-mla",
+            dtype=DTYPE,
+            num_pages=64,
+            page_size=8,
+            max_pages_per_seq=16,
+            max_batch_size=2,
+            prefill_buckets=(16,),
+        ))
+        assert eng.attn_impl == "xla"
+        outs.append(eng.generate([[1, 2, 3, 4], [9, 8, 7]], None))
+    assert outs[0] == outs[1]
+    assert all(len(t) >= 1 for t in outs[0])
+
+
+def test_deepseek_presets_validate():
+    """The real DeepSeek configs construct valid parameter trees (checked
+    abstractly — no 671B allocation) with the MLA geometry invariants."""
+    for name in ("deepseek-v2-lite", "deepseek-v3"):
+        cfg = get_config_preset(name)
+        assert cfg.mla is not None
+        assert cfg.head_dim_ == cfg.mla.qk_head_dim
+        shapes = jax.eval_shape(
+            lambda c=cfg: llama.init_params(
+                c, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+            )
+        )
+        specs = llama.param_specs(cfg)
+        # Every param leaf has a matching spec leaf.
+        assert jax.tree.structure(
+            shapes, is_leaf=lambda x: hasattr(x, "shape")
+        ).num_leaves == jax.tree.structure(specs).num_leaves
+
+
+def test_mla_geometry_validation():
+    bad = dataclasses.replace(CFG, head_dim=32)
+    with pytest.raises(ValueError, match="qk_head_dim"):
+        llama.init_params(bad, jax.random.PRNGKey(0), dtype=DTYPE)
+
+
+def test_rope_convention_matches_hf_interleaved():
+    """Loading permutes DeepSeek's INTERLEAVED rope columns to half-split;
+    attention scores through our (permuted weights + half-split rope)
+    path must equal the HF convention (interleaved weights, activations
+    de-interleaved before rotate_half). Scores are the invariant —
+    per-dim layout cancels when q and k are permuted consistently."""
+    from opsagent_tpu.models.loader import _rope_interleave_to_halfsplit
+    from opsagent_tpu.ops.rope import apply_rope, rope_table
+
+    rng = np.random.default_rng(0)
+    d, dr, S = 12, 8, 5
+    x = rng.standard_normal((1, S, d)).astype(np.float32)
+    w = rng.standard_normal((d, dr)).astype(np.float32)  # HF layout
+    positions = jnp.arange(S)[None, :]
+    cos, sin = rope_table(positions, dr, 10000.0)
+
+    # HF convention: project with raw weights, de-interleave activations,
+    # then standard half-split rotate (what rotate_half + their transpose
+    # trick computes).
+    perm = _rope_interleave_to_halfsplit(dr)
+    hf_act = (x @ w)[..., perm]            # de-interleave == perm gather
+    hf_roped = apply_rope(
+        jnp.asarray(hf_act)[:, :, None, :], cos, sin
+    )[:, :, 0]
+
+    # Our convention: permute WEIGHT columns at load, then half-split rope.
+    ours_act = x @ w[:, perm]
+    ours_roped = apply_rope(
+        jnp.asarray(ours_act)[:, :, None, :], cos, sin
+    )[:, :, 0]
+
+    np.testing.assert_allclose(
+        np.asarray(hf_roped), np.asarray(ours_roped), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_engine_rejects_prompt_beyond_context_window():
+    from opsagent_tpu.serving.engine import Engine, EngineConfig, InvalidRequest
+
+    eng = Engine(EngineConfig(
+        model="tiny-mla", dtype=DTYPE, num_pages=64, page_size=8,
+        max_pages_per_seq=400, max_batch_size=1, prefill_buckets=(16,),
+    ))
+    too_long = list(range(1, CFG.max_position + 2))
+    with pytest.raises(InvalidRequest, match="context window"):
+        eng.begin_request([t % 500 for t in too_long])
+
+
+def test_generation_budget_clamped_to_context_window():
+    """Admission clamps max_tokens so decode never runs rope positions
+    past the model window; the request finishes with reason 'length'."""
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    pages_needed = (CFG.max_position // 8) + 4
+    eng = Engine(EngineConfig(
+        model="tiny-mla", dtype=DTYPE, num_pages=pages_needed + 8,
+        page_size=8, max_pages_per_seq=pages_needed, max_batch_size=1,
+        prefill_buckets=(2048,),
+    ))
+    n = CFG.max_position - 3
+    sid = eng.add_request(
+        [1 + (i % 400) for i in range(n)],
+        SamplingParams(temperature=0.0, max_tokens=500),
+    )
+    assert eng.sequences[sid].params.max_tokens == 3

@@ -433,3 +433,65 @@ class TestExpertParallelism:
             lambda x: llama._ep_constrain(x, P("ep", None))
         )(jnp.ones((4, 8)))
         assert "ep" not in str(z.sharding)
+
+
+def test_sigmoid_router_with_bias_and_groups():
+    """DeepSeek-V3 routing semantics (noaux_tc): sigmoid scores, the
+    e_score_correction_bias steers SELECTION only (combine weights use
+    raw sigmoid scores), and group-limited top-k confines selection to
+    the best topk_group expert groups."""
+    import dataclasses
+
+    import numpy as np
+
+    from opsagent_tpu.models import llama
+    from opsagent_tpu.models.config import MoEConfig, get_config_preset
+
+    base = get_config_preset("tiny-moe")
+    cfg = dataclasses.replace(
+        base,
+        moe=MoEConfig(
+            num_experts=4,
+            num_experts_per_token=2,
+            num_shared_experts=0,
+            expert_intermediate_size=8,
+            scoring_func="sigmoid",
+            n_group=2,
+            topk_group=1,
+            grouped_dispatch_min_tokens=7777,  # force all-experts scan
+        ),
+    )
+    d, fe, E = cfg.hidden_size, 8, 4
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.standard_normal((1, 3, d)), jnp.float32)
+    lp = {
+        # Router logits biased so experts 0 and 2 (in DIFFERENT groups)
+        # score highest pre-bias.
+        "router": jnp.asarray(
+            np.stack([
+                np.full((d,), 0.05), np.full((d,), -0.05),
+                np.full((d,), 0.04), np.full((d,), -0.04),
+            ], axis=1), jnp.float32,
+        ),
+        "router_bias": jnp.zeros((E,), jnp.float32),
+        "eg": jnp.asarray(rng.standard_normal((E, d, fe)) * 0.1, jnp.float32),
+        "eu": jnp.asarray(rng.standard_normal((E, d, fe)) * 0.1, jnp.float32),
+        "ed": jnp.asarray(rng.standard_normal((E, fe, d)) * 0.1, jnp.float32),
+    }
+    out_nobias, _ = llama._moe_mlp(h, lp, cfg)
+
+    # A large selection bias on group 1's experts (ids 2,3) must flip the
+    # chosen GROUP — changing the output — while zero bias keeps it.
+    lp_biased = dict(lp, router_bias=jnp.asarray(
+        [0.0, 0.0, 50.0, 50.0], jnp.float32
+    ))
+    out_biased, _ = llama._moe_mlp(h, lp_biased, cfg)
+    assert not np.allclose(np.asarray(out_nobias), np.asarray(out_biased))
+
+    # Bias steers selection only: with selection UNCHANGED (bias uniform
+    # across experts), outputs are identical — combine weights ignore it.
+    lp_uniform = dict(lp, router_bias=jnp.full((E,), 7.0, jnp.float32))
+    out_uniform, _ = llama._moe_mlp(h, lp_uniform, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out_nobias), np.asarray(out_uniform), rtol=1e-6, atol=1e-6
+    )
