@@ -34,8 +34,10 @@ class MoEConfig:
     # sigmoid scores.
     scoring_func: str = "softmax"
     # Group-limited top-k (HF n_group/topk_group): experts partition into
-    # n_group groups; only the topk_group best groups (by the sum of each
-    # group's top-2 selection scores) are eligible. 1/1 disables.
+    # n_group groups; only the topk_group best groups are eligible.
+    # Group ranking follows scoring_func: sigmoid (V3 noaux_tc) ranks by
+    # top-2 sum, softmax (V2 group_limited_greedy) by group max. 1/1
+    # disables.
     n_group: int = 1
     topk_group: int = 1
     # Grouped-dispatch policy. Below the token threshold (decode steps,
@@ -71,6 +73,25 @@ class MLAConfig:
 
 
 @dataclass(frozen=True)
+class RopeScalingConfig:
+    """Long-context rope frequency scaling (ops/rope.py implements the
+    math). ``rope_type``: "llama3" (Llama-3.1's wavelength-banded
+    interpolation) or "yarn" (DeepSeek-V2/V3; NTK-by-parts with mscale)."""
+
+    rope_type: str
+    factor: float
+    original_max_position: int
+    # llama3
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    # yarn
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    mscale: float = 1.0
+    mscale_all_dim: float = 0.0
+
+
+@dataclass(frozen=True)
 class ModelConfig:
     name: str
     vocab_size: int
@@ -92,6 +113,9 @@ class ModelConfig:
     # num_kv_heads == num_heads (MLA has no GQA; the latent IS the
     # compression).
     mla: Optional[MLAConfig] = None
+    # Long-context rope scaling; when set, max_position may cover the
+    # scaled window (factor x original_max_position).
+    rope_scaling: Optional[RopeScalingConfig] = None
 
     @property
     def head_dim_(self) -> int:
@@ -219,6 +243,16 @@ LLAMA31_70B = _register(
         num_heads=64,
         num_kv_heads=8,
         rope_theta=500000.0,
+        # 128k window via Llama-3.1's wavelength-banded rope scaling
+        # (HF rope_scaling rope_type=llama3, factor 8 over the 8k
+        # original window).
+        rope_scaling=RopeScalingConfig(
+            rope_type="llama3",
+            factor=8.0,
+            original_max_position=8192,
+            low_freq_factor=1.0,
+            high_freq_factor=4.0,
+        ),
     )
 )
 
@@ -289,11 +323,18 @@ DEEPSEEK_V2_LITE = _register(
         head_dim=192,               # qk_nope (128) + qk_rope (64)
         rope_theta=10000.0,
         rms_norm_eps=1e-6,
-        # The HF checkpoint extends to 160k via YaRN rope scaling, which
-        # is not implemented yet (neither the per-dim interpolation nor
-        # the mscale softmax-scale factor); admit only the NATIVE window
-        # so long requests fail loudly instead of degenerating.
-        max_position=4096,
+        # 160k window via YaRN (factor 40 over the 4k native window),
+        # per the HF config's rope_scaling block.
+        max_position=163840,
+        rope_scaling=RopeScalingConfig(
+            rope_type="yarn",
+            factor=40.0,
+            original_max_position=4096,
+            beta_fast=32.0,
+            beta_slow=1.0,
+            mscale=0.707,
+            mscale_all_dim=0.707,
+        ),
         moe=MoEConfig(
             num_experts=64,
             num_experts_per_token=6,
@@ -327,8 +368,17 @@ DEEPSEEK_V3 = _register(
         head_dim=192,
         rope_theta=10000.0,
         rms_norm_eps=1e-6,
-        # YaRN (factor 40 -> 160k) not yet implemented: native window only.
-        max_position=4096,
+        # 160k via YaRN (factor 40, mscale 1.0 both) per the HF config.
+        max_position=163840,
+        rope_scaling=RopeScalingConfig(
+            rope_type="yarn",
+            factor=40.0,
+            original_max_position=4096,
+            beta_fast=32.0,
+            beta_slow=1.0,
+            mscale=1.0,
+            mscale_all_dim=1.0,
+        ),
         moe=MoEConfig(
             num_experts=256,
             num_experts_per_token=8,

@@ -451,6 +451,7 @@ def _qkv_mla(
     q = jnp.concatenate(
         [q[..., :dn], apply_rope(q[..., dn:], cos, sin)], axis=-1
     )
+    q = q * _yarn_q_scale(cfg)
     ckv = rms_norm(_mm(x, lp["wdkv"]), lp["kv_norm"], cfg.rms_norm_eps)
     k_rope = apply_rope(
         _mm(x, lp["wkr"]).reshape(B, S, 1, dr), cos, sin
@@ -465,6 +466,20 @@ def _qkv_mla(
     return q, k, v
 
 
+def _yarn_q_scale(cfg: ModelConfig) -> float:
+    """YaRN softmax-scale correction (HF: softmax_scale *= mscale^2 with
+    mscale = yarn_get_mscale(factor, mscale_all_dim)); folded into q so
+    the shared attention paths' 1/sqrt(head_dim) stays untouched. 1.0
+    when no yarn mscale_all_dim applies."""
+    rs = cfg.rope_scaling
+    if rs is None or rs.rope_type != "yarn" or not rs.mscale_all_dim:
+        return 1.0
+    from ..ops.rope import yarn_get_mscale
+
+    ms = yarn_get_mscale(rs.factor, rs.mscale_all_dim)
+    return ms * ms
+
+
 def _qkv_rope(
     x: jax.Array, lp: Params, cfg: ModelConfig, cos, sin
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -474,7 +489,11 @@ def _qkv_rope(
     if cfg.mla is not None:
         return _qkv_mla(x, lp, cfg, cos, sin)
     q, k, v = _qkv(x, lp, cfg)
-    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+    return (
+        apply_rope(q, cos, sin) * _yarn_q_scale(cfg),
+        apply_rope(k, cos, sin),
+        v,
+    )
 
 
 def _mlp(x: jax.Array, lp: Params) -> jax.Array:
@@ -525,12 +544,17 @@ def _moe_mlp(
     if "router_bias" in lp:
         select = select + lp["router_bias"]
     if m.n_group > 1:
-        # Group-limited top-k: rank groups by the sum of each group's top-2
-        # selection scores; experts outside the best topk_group groups are
-        # ineligible.
+        # Group-limited top-k: experts outside the best topk_group groups
+        # are ineligible. Group ranking follows the checkpoint's method:
+        # V3's noaux_tc (sigmoid) ranks groups by the sum of their top-2
+        # selection scores; V2's group_limited_greedy (softmax) ranks by
+        # the group's single best score.
         Bd, Sd = select.shape[:2]
         g = select.reshape(Bd, Sd, m.n_group, E // m.n_group)
-        group_score = jnp.sum(jax.lax.top_k(g, 2)[0], axis=-1)      # [B,S,G]
+        if m.scoring_func == "sigmoid":
+            group_score = jnp.sum(jax.lax.top_k(g, 2)[0], axis=-1)  # [B,S,G]
+        else:
+            group_score = jnp.max(g, axis=-1)                       # [B,S,G]
         _, keep_idx = jax.lax.top_k(group_score, m.topk_group)
         keep = jnp.sum(
             jax.nn.one_hot(keep_idx, m.n_group, dtype=select.dtype), axis=-2
@@ -716,7 +740,8 @@ def prefill(
     stay on the pjit-partitioned scatter either way."""
     B, S = tokens.shape
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
-    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     x = params["embed"][tokens].astype(dtype)
     start = jnp.zeros((B,), jnp.int32)
     attn_op = prefill_attn or causal_prefill_attention
@@ -752,7 +777,8 @@ def prefill_with_prefix(
     (last-tail-position logits [B, V], updated cache)."""
     B, S = tokens.shape
     positions = start[:, None] + jnp.arange(S)[None, :]
-    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     x = params["embed"][tokens].astype(dtype)
 
     def attn_fn(h, lp, kc, vc, li):
@@ -795,7 +821,8 @@ def verify_step(
     forward, the whole point of speculation)."""
     B, S = tokens.shape
     positions = start[:, None] + jnp.arange(S)[None, :]
-    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     x = params["embed"][tokens].astype(dtype)
 
     def attn_fn(h, lp, kc, vc, li):
@@ -830,7 +857,8 @@ def decode_step(
     updated cache)."""
     B = tokens.shape[0]
     positions = lengths[:, None]                       # [B, 1]
-    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     x = params["embed"][tokens[:, None]].astype(dtype)  # [B, 1, D]
     valid = active.astype(jnp.int32)                   # [B] 1 new token if active
 
@@ -874,7 +902,8 @@ def forward_full(
     (zero for dense models)."""
     B, S = tokens.shape
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
-    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
     x = params["embed"][tokens].astype(dtype)
     attn_op = prefill_attn or causal_prefill_attention
 

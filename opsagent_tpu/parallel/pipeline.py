@@ -148,7 +148,8 @@ def make_pipeline_loss(
         positions = jnp.arange(S)[None, :].repeat(mb, axis=0)
         from ..ops.rope import rope_table
 
-        cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta)
+        cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
 
         # Embedding is replicated over pp: every stage computes the same
         # xs, only stage 0's enters the pipeline (the where below).

@@ -1,0 +1,116 @@
+"""Long-context rope scaling (ops/rope.py): llama3 banded interpolation
+and YaRN, against independently computed reference values."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from opsagent_tpu.models.config import RopeScalingConfig, get_config_preset
+from opsagent_tpu.ops.rope import rope_table, yarn_get_mscale
+
+
+def _freqs_from_table(dim, theta, scaling):
+    """Recover inv freqs by evaluating the table at position 1."""
+    cos, sin = rope_table(jnp.asarray([[1]]), dim, theta, scaling=scaling)
+    # angle = inv_freq * 1; magnitude factor divides out via atan2.
+    return np.arctan2(np.asarray(sin)[0, 0], np.asarray(cos)[0, 0])
+
+
+def test_llama3_banded_interpolation():
+    dim, theta = 128, 500000.0
+    sc = RopeScalingConfig(
+        rope_type="llama3", factor=8.0, original_max_position=8192,
+        low_freq_factor=1.0, high_freq_factor=4.0,
+    )
+    base = _freqs_from_table(dim, theta, None)
+    scaled = _freqs_from_table(dim, theta, sc)
+    # Reference formula, straight from the HF implementation.
+    ref = []
+    for inv in base:
+        wl = 2 * math.pi / inv
+        low_wl = 8192 / 1.0
+        high_wl = 8192 / 4.0
+        if wl > low_wl:
+            ref.append(inv / 8.0)
+        elif wl < high_wl:
+            ref.append(inv)
+        else:
+            smooth = (8192 / wl - 1.0) / (4.0 - 1.0)
+            ref.append((1 - smooth) * inv / 8.0 + smooth * inv)
+    np.testing.assert_allclose(scaled, ref, rtol=1e-5)
+    # High-frequency dims untouched; lowest-frequency dims divided by 8.
+    assert np.isclose(scaled[0], base[0], rtol=1e-6)
+    assert np.isclose(scaled[-1], base[-1] / 8.0, rtol=1e-4)
+
+
+def test_yarn_interpolation_and_mscale():
+    dim, theta = 64, 10000.0
+    sc = RopeScalingConfig(
+        rope_type="yarn", factor=40.0, original_max_position=4096,
+        beta_fast=32.0, beta_slow=1.0, mscale=0.707, mscale_all_dim=0.707,
+    )
+    base_cos, _ = rope_table(jnp.asarray([[0]]), dim, theta)
+    sc_cos, _ = rope_table(jnp.asarray([[0]]), dim, theta, scaling=sc)
+    # mscale/mscale_all_dim equal -> table magnitude factor is 1.
+    np.testing.assert_allclose(
+        np.asarray(sc_cos), np.asarray(base_cos), rtol=1e-6
+    )
+
+    base = _freqs_from_table(dim, theta, None)
+    scaled = _freqs_from_table(dim, theta, sc)
+    # Fastest dims extrapolate (unchanged); slowest fully interpolate.
+    assert np.isclose(scaled[0], base[0], rtol=1e-5)
+    assert np.isclose(scaled[-1], base[-1] / 40.0, rtol=1e-3)
+    # Monotone nonincreasing frequencies, no NaN.
+    assert np.all(np.diff(scaled) <= 1e-9)
+
+    # V3-style mscale_all_dim=1.0 vs mscale=1.0 -> magnitude factor 1,
+    # but with mscale_all_dim=0 the factor is yarn_get_mscale(40, 1.0).
+    sc2 = RopeScalingConfig(
+        rope_type="yarn", factor=40.0, original_max_position=4096,
+        mscale=1.0, mscale_all_dim=0.0,
+    )
+    c2, _ = rope_table(jnp.asarray([[0]]), dim, theta, scaling=sc2)
+    np.testing.assert_allclose(
+        np.asarray(c2), np.asarray(base_cos) * yarn_get_mscale(40.0, 1.0),
+        rtol=1e-6,
+    )
+
+
+def test_deepseek_presets_reopen_scaled_window():
+    for name in ("deepseek-v2-lite", "deepseek-v3"):
+        cfg = get_config_preset(name)
+        assert cfg.rope_scaling is not None
+        assert cfg.rope_scaling.rope_type == "yarn"
+        assert cfg.max_position == 163840
+
+
+def test_llama31_preset_scaled():
+    cfg = get_config_preset("llama-3.1-70b-instruct")
+    assert cfg.rope_scaling.rope_type == "llama3"
+
+
+def test_scaled_model_forward_finite_past_native_window():
+    """A tiny yarn-scaled model decodes at positions past the original
+    window without NaN (the point of the scaling)."""
+    import dataclasses
+
+    import jax
+
+    from opsagent_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        get_config_preset("tiny-mla"),
+        max_position=8192,
+        rope_scaling=RopeScalingConfig(
+            rope_type="yarn", factor=16.0, original_max_position=512,
+            mscale=1.0, mscale_all_dim=1.0,
+        ),
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (1, 700), 0, cfg.vocab_size
+    )
+    logits = llama.forward_full(params, cfg, tokens, dtype=jnp.float32)
+    assert bool(jnp.isfinite(logits).all())
