@@ -54,22 +54,34 @@ class MoEConfig:
 @dataclass(frozen=True)
 class MLAConfig:
     """Multi-head Latent Attention (DeepSeek-V2/V3): low-rank q and kv
-    projections with a decoupled per-head-SHARED RoPE part. Served here in
-    the uncompressed-cache form — k/v are materialized per head and ride
-    the standard paged cache (v zero-padded to the qk head dim so every
-    attention path is shared); the compressed-latent cache (kv_lora_rank
-    + rope dims per token) is a planned optimization. YaRN length scaling
-    (mscale) is not yet applied."""
+    projections with a decoupled per-head-SHARED RoPE part. Two serving
+    layouts: ``latent_cache=False`` materializes per-head k/v onto the
+    standard paged cache (v zero-padded to the qk head dim so every
+    attention path is shared); ``latent_cache=True`` stores the compressed
+    per-token latent and decodes in the weight-absorbed MQA form — the
+    memory/bandwidth win that motivates MLA."""
 
     q_lora_rank: int = 0           # 0 = full-rank q projection (V2-Lite)
     kv_lora_rank: int = 512
     qk_nope_head_dim: int = 128
     qk_rope_head_dim: int = 64
     v_head_dim: int = 128
+    # Serve with the COMPRESSED latent cache: pages hold one
+    # (kv_lora_rank + qk_rope_head_dim)-dim latent per token instead of
+    # per-head k/v — the point of MLA (V3: 576 vs 49152 floats/token,
+    # ~85x less KV memory/bandwidth). Decode absorbs the kv
+    # up-projection into the query (per-head latent queries, MQA-style
+    # attention over the shared latent); prefill attends materialized
+    # and writes latents. False = uncompressed per-head cache.
+    latent_cache: bool = False
 
     @property
     def qk_head_dim(self) -> int:
         return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def latent_dim(self) -> int:
+        return self.kv_lora_rank + self.qk_rope_head_dim
 
 
 @dataclass(frozen=True)
@@ -348,6 +360,7 @@ DEEPSEEK_V2_LITE = _register(
             qk_nope_head_dim=128,
             qk_rope_head_dim=64,
             v_head_dim=128,
+            latent_cache=True,
         ),
     )
 )
@@ -397,6 +410,7 @@ DEEPSEEK_V3 = _register(
             qk_nope_head_dim=128,
             qk_rope_head_dim=64,
             v_head_dim=128,
+            latent_cache=True,
         ),
     )
 )

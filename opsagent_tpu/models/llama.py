@@ -36,6 +36,7 @@ from ..ops.attention import (
     paged_decode_attention_auto,
     paged_prefix_attention,
     write_kv_pages,
+    write_pages,
 )
 from ..ops.rope import apply_rope, rope_table
 from .config import ModelConfig
@@ -315,20 +316,42 @@ def param_specs(cfg: ModelConfig) -> Params:
     return specs
 
 
+def _latent_cache(cfg: ModelConfig) -> bool:
+    return cfg.mla is not None and cfg.mla.latent_cache
+
+
 def make_cache(
     cfg: ModelConfig,
     num_pages: int,
     page_size: int,
     dtype: jnp.dtype = jnp.bfloat16,
 ) -> Params:
-    """Paged KV cache pytree: pages stacked over layers."""
-    L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+    """Paged KV cache pytree: pages stacked over layers. MLA latent mode
+    stores ONE (kv_lora_rank + rope)-dim latent per token in ``k`` — the
+    compression that motivates MLA — with a 1-dim placeholder ``v`` (the
+    pytree shape is shared with the standard layout so the engine's
+    donation/restart plumbing is layout-agnostic)."""
+    L = cfg.num_layers
+    if _latent_cache(cfg):
+        shape_k = (L, num_pages, page_size, 1, cfg.mla.latent_dim)
+        shape_v = (L, num_pages, page_size, 1, 1)
+        return {
+            "k": jnp.zeros(shape_k, dtype), "v": jnp.zeros(shape_v, dtype)
+        }
+    K, D = cfg.num_kv_heads, cfg.head_dim_
     shape = (L, num_pages, page_size, K, D)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def cache_specs(cfg: ModelConfig) -> Params:
-    """KV pages are sharded over the kv-head axis (tp), like wk/wv."""
+    """KV pages are sharded over the kv-head axis (tp), like wk/wv. The
+    MLA latent cache has ONE shared 'head' — replicated over tp (it is
+    per-token global state; queries/outputs still shard over heads)."""
+    if _latent_cache(cfg):
+        return {
+            "k": P(None, None, None, None, None),
+            "v": P(None, None, None, None, None),
+        }
     return {
         "k": P(None, None, None, "tp", None),
         "v": P(None, None, None, "tp", None),
@@ -424,8 +447,9 @@ def _qkv(
 
 
 def _qkv_mla(
-    x: jax.Array, lp: Params, cfg: ModelConfig, cos, sin
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    x: jax.Array, lp: Params, cfg: ModelConfig, cos, sin,
+    with_latent: bool = False,
+):
     """MLA q/k/v with decoupled RoPE (DeepSeek-V2/V3):
 
     - q: (optionally low-rank) projection to H x (nope + rope) dims; RoPE
@@ -442,20 +466,9 @@ def _qkv_mla(
     H = cfg.num_heads
     dn, dr, dv = m.qk_nope_head_dim, m.qk_rope_head_dim, m.v_head_dim
     dq = dn + dr
-    if m.q_lora_rank:
-        cq = rms_norm(_mm(x, lp["wdq"]), lp["q_norm"], cfg.rms_norm_eps)
-        q = _mm(cq, lp["wuq"])
-    else:
-        q = _mm(x, lp["wq"])
-    q = q.reshape(B, S, H, dq)
-    q = jnp.concatenate(
-        [q[..., :dn], apply_rope(q[..., dn:], cos, sin)], axis=-1
-    )
-    q = q * _yarn_q_scale(cfg)
-    ckv = rms_norm(_mm(x, lp["wdkv"]), lp["kv_norm"], cfg.rms_norm_eps)
-    k_rope = apply_rope(
-        _mm(x, lp["wkr"]).reshape(B, S, 1, dr), cos, sin
-    )
+    q_nope, q_rope = _mla_q(x, lp, cfg, cos, sin)
+    q = jnp.concatenate([q_nope, q_rope], axis=-1) * _yarn_q_scale(cfg)
+    ckv, k_rope = _mla_kv_latent(x, lp, cfg, cos, sin)
     kv = _mm(ckv, lp["wukv"]).reshape(B, S, H, dn + dv)
     k = jnp.concatenate(
         [kv[..., :dn], jnp.broadcast_to(k_rope, (B, S, H, dr))], axis=-1
@@ -463,7 +476,97 @@ def _qkv_mla(
     v = jnp.concatenate(
         [kv[..., dn:], jnp.zeros((B, S, H, dq - dv), kv.dtype)], axis=-1
     )
+    if with_latent:
+        latent = jnp.concatenate(
+            [ckv[:, :, None, :], k_rope], axis=-1
+        ).astype(x.dtype)
+        return q, k, v, latent
     return q, k, v
+
+
+def _mla_q(x, lp, cfg: ModelConfig, cos, sin):
+    """(q_nope [B,S,H,dn], roped q_rope [B,S,H,dr])."""
+    m = cfg.mla
+    B, S, _ = x.shape
+    H = cfg.num_heads
+    dn, dr = m.qk_nope_head_dim, m.qk_rope_head_dim
+    if m.q_lora_rank:
+        cq = rms_norm(_mm(x, lp["wdq"]), lp["q_norm"], cfg.rms_norm_eps)
+        q = _mm(cq, lp["wuq"])
+    else:
+        q = _mm(x, lp["wq"])
+    q = q.reshape(B, S, H, dn + dr)
+    return q[..., :dn], apply_rope(q[..., dn:], cos, sin)
+
+
+def _mla_kv_latent(x, lp, cfg: ModelConfig, cos, sin):
+    """(normed kv latent [B,S,rkv], roped shared key [B,S,1,dr])."""
+    m = cfg.mla
+    B, S, _ = x.shape
+    ckv = rms_norm(_mm(x, lp["wdkv"]), lp["kv_norm"], cfg.rms_norm_eps)
+    k_rope = apply_rope(
+        _mm(x, lp["wkr"]).reshape(B, S, 1, m.qk_rope_head_dim), cos, sin
+    )
+    return ckv, k_rope
+
+
+def _dense_weight(w: Any) -> jax.Array:
+    """Materialize a weight that code must reshape/slice (the MLA absorbed
+    path reshapes wukv per head): dequantizes int8 QuantizedLinear leaves
+    — XLA fuses the dequantize into the consuming einsum's operand read."""
+    from .quant import QuantizedLinear
+
+    if isinstance(w, QuantizedLinear):
+        return w.dequantize()
+    return w
+
+
+def _mla_latent_parts(x, lp, cfg: ModelConfig, cos, sin):
+    """Weight-absorbed form for the LATENT cache: per-head latent queries
+    and the per-token latent to write.
+
+    Per head h: score_h(t) ∝ q_nope·(W_uk c_t) + q_rope·kr_t
+              = (W_uk^T q_nope)·c_t + q_rope·kr_t — one MQA-style dot of
+    q_lat[h] = [W_uk^T q_nope[h], q_rope[h]] against latent_t = [c_t, kr_t].
+    The shared attention ops scale by latent_dim^-0.5, so q_lat is
+    pre-scaled by sqrt(latent_dim/qk_head_dim) (plus the YaRN correction)
+    to restore the true qk_head_dim^-0.5 softmax scale.
+
+    Returns (q_lat [B,S,H,DL], latent [B,S,1,DL])."""
+    m = cfg.mla
+    H = cfg.num_heads
+    dn = m.qk_nope_head_dim
+    dv = m.v_head_dim
+    rkv = m.kv_lora_rank
+    DL, dq = m.latent_dim, m.qk_head_dim
+    q_nope, q_rope = _mla_q(x, lp, cfg, cos, sin)
+    w_uk = _dense_weight(lp["wukv"]).reshape(rkv, H, dn + dv)[:, :, :dn]
+    q_abs = jnp.einsum("bshd,rhd->bshr", q_nope, w_uk)      # [B,S,H,rkv]
+    scale = (DL ** 0.5) / (dq ** 0.5) * _yarn_q_scale(cfg)
+    q_lat = jnp.concatenate([q_abs, q_rope], axis=-1) * scale
+    ckv, k_rope = _mla_kv_latent(x, lp, cfg, cos, sin)
+    latent = jnp.concatenate([ckv[:, :, None, :], k_rope], axis=-1)
+    return q_lat.astype(x.dtype), latent.astype(x.dtype)
+
+
+def _mla_latent_out(ctx, lp, cfg: ModelConfig):
+    """Attention output over latent VALUES -> padded per-head layout.
+
+    ctx [B,S,H,DL]: only the first rkv dims are meaningful (the attention
+    averaged the latents; the rope dims are discarded). o_h = W_uv^T ctx_c
+    recovers each head's v_head_dim output, zero-padded to qk_head_dim so
+    the stack's shared ``wo`` matmul applies unchanged."""
+    m = cfg.mla
+    B, S, H, _ = ctx.shape
+    dn, dv = m.qk_nope_head_dim, m.v_head_dim
+    rkv = m.kv_lora_rank
+    dq = m.qk_head_dim
+    w_uv = _dense_weight(lp["wukv"]).reshape(rkv, H, dn + dv)[:, :, dn:]
+    o = jnp.einsum("bshr,rhv->bshv", ctx[..., :rkv], w_uv)  # [B,S,H,dv]
+    o = jnp.concatenate(
+        [o, jnp.zeros((B, S, H, dq - dv), o.dtype)], axis=-1
+    )
+    return o.reshape(B, S, H * dq).astype(ctx.dtype)
 
 
 def _yarn_q_scale(cfg: ModelConfig) -> float:
@@ -747,10 +850,20 @@ def prefill(
     attn_op = prefill_attn or causal_prefill_attention
 
     def attn_fn(h, lp, kc, vc, li):
-        q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
-        kc, vc = write_kv_pages(
-            kc, vc, k, v, page_table, start, valid_len=lengths, layer=li
-        )
+        if _latent_cache(cfg):
+            # Fresh prefill attends MATERIALIZED (exact, composes with
+            # the sp ring attention) but writes only the latent.
+            q, k, v, latent = _qkv_mla(
+                h, lp, cfg, cos, sin, with_latent=True
+            )
+            kc = write_pages(
+                kc, latent, page_table, start, valid_len=lengths, layer=li
+            )
+        else:
+            q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
+            kc, vc = write_kv_pages(
+                kc, vc, k, v, page_table, start, valid_len=lengths, layer=li
+            )
         attn = attn_op(q, k, v, lengths=lengths)
         return attn.reshape(B, S, -1), kc, vc
 
@@ -782,6 +895,15 @@ def prefill_with_prefix(
     x = params["embed"][tokens].astype(dtype)
 
     def attn_fn(h, lp, kc, vc, li):
+        if _latent_cache(cfg):
+            q_lat, latent = _mla_latent_parts(h, lp, cfg, cos, sin)
+            kc = write_pages(
+                kc, latent, page_table, start, valid_len=lengths, layer=li
+            )
+            ctx = paged_prefix_attention(
+                q_lat, kc, kc, page_table, start, lengths, layer=li
+            )
+            return _mla_latent_out(ctx, lp, cfg), kc, vc
         q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
         kc, vc = write_kv_pages(
             kc, vc, k, v, page_table, start, valid_len=lengths, layer=li
@@ -826,6 +948,15 @@ def verify_step(
     x = params["embed"][tokens].astype(dtype)
 
     def attn_fn(h, lp, kc, vc, li):
+        if _latent_cache(cfg):
+            q_lat, latent = _mla_latent_parts(h, lp, cfg, cos, sin)
+            kc = write_pages(
+                kc, latent, page_table, start, valid_len=valid, layer=li
+            )
+            ctx = paged_prefix_attention(
+                q_lat, kc, kc, page_table, start, valid, layer=li
+            )
+            return _mla_latent_out(ctx, lp, cfg), kc, vc
         q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
         kc, vc = write_kv_pages(
             kc, vc, k, v, page_table, start, valid_len=valid, layer=li
@@ -863,6 +994,16 @@ def decode_step(
     valid = active.astype(jnp.int32)                   # [B] 1 new token if active
 
     def attn_fn(h, lp, kc, vc, li):
+        if _latent_cache(cfg):
+            q_lat, latent = _mla_latent_parts(h, lp, cfg, cos, sin)
+            kc = write_pages(
+                kc, latent, page_table, lengths, valid_len=valid, layer=li
+            )
+            ctx = paged_decode_attention_auto(
+                q_lat[:, 0], kc, kc, page_table, lengths + valid,
+                impl=attn_impl, layer=li, mesh=mesh,
+            )
+            return _mla_latent_out(ctx[:, None], lp, cfg), kc, vc
         q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
         kc, vc = write_kv_pages(
             kc, vc, k, v, page_table, lengths, valid_len=valid, layer=li

@@ -204,15 +204,34 @@ def write_kv_pages(
     updates the carry in place, where per-layer stacked scan outputs would
     copy the entire cache every step (~GBs/step at serving shapes).
     """
-    if k_pages.ndim == 5:
-        L, N, P, K, D = k_pages.shape
+    k_pages = write_pages(
+        k_pages, k_new, page_table, start, valid_len=valid_len, layer=layer
+    )
+    v_pages = write_pages(
+        v_pages, v_new, page_table, start, valid_len=valid_len, layer=layer
+    )
+    return k_pages, v_pages
+
+
+def write_pages(
+    pages: jax.Array,       # [N, P, K, D] — or [L, N, P, K, D] with layer
+    new: jax.Array,         # [B, S, K, D]
+    page_table: jax.Array,  # [B, MaxP] int32 page indices (-1 = unassigned)
+    start: jax.Array,       # [B] int32 write offset (tokens already in cache)
+    valid_len: jax.Array | None = None,  # [B] number of valid new tokens
+    layer: jax.Array | None = None,  # [] int32 when pages carry a layer axis
+) -> jax.Array:
+    """Single-array page scatter (``write_kv_pages`` for one side; the MLA
+    latent cache writes only one array per token)."""
+    if pages.ndim == 5:
+        L, N, P, K, D = pages.shape
         total = L * N
         base = (layer if layer is not None else 0) * N
     else:
-        N, P, K, D = k_pages.shape
+        N, P, K, D = pages.shape
         total = N
         base = 0
-    B, S = k_new.shape[:2]
+    B, S = new.shape[:2]
     oob = total * P  # drop sentinel: one past the last flat slot
     pos = start[:, None] + jnp.arange(S)[None, :]          # [B, S]
     page_idx = jnp.take_along_axis(
@@ -225,12 +244,10 @@ def write_kv_pages(
     else:
         flat = jnp.where(page_idx >= 0, flat, oob)
     flat = flat.reshape(B * S)
-    shape = k_pages.shape
-    kf = k_pages.reshape(total * P, K, D)
-    vf = v_pages.reshape(total * P, K, D)
-    kf = kf.at[flat].set(k_new.reshape(B * S, K, D), mode="drop")
-    vf = vf.at[flat].set(v_new.reshape(B * S, K, D), mode="drop")
-    return kf.reshape(shape), vf.reshape(shape)
+    shape = pages.shape
+    pf = pages.reshape(total * P, K, D)
+    pf = pf.at[flat].set(new.reshape(B * S, K, D), mode="drop")
+    return pf.reshape(shape)
 
 
 def paged_prefix_attention(

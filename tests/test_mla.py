@@ -219,3 +219,109 @@ def test_generation_budget_clamped_to_context_window():
         SamplingParams(temperature=0.0, max_tokens=500),
     )
     assert eng.sequences[sid].params.max_tokens == 3
+
+
+LATENT_CFG = dataclasses.replace(
+    CFG, mla=dataclasses.replace(CFG.mla, latent_cache=True)
+)
+
+
+def test_latent_cache_decode_chain_matches_oracle(params):
+    """The weight-absorbed latent cache (MQA over [c_kv, k_rope] latents)
+    must reproduce the materialized attention exactly: prefill + decode
+    chain against the forward_full oracle, same weights."""
+    S_total, S_prompt = 10, 4
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(6), (1, S_total), 0, CFG.vocab_size
+    )
+    full = llama.forward_full(params, LATENT_CFG, tokens, dtype=DTYPE)
+
+    cache = llama.make_cache(LATENT_CFG, num_pages=8, page_size=4, dtype=DTYPE)
+    assert cache["k"].shape[-1] == LATENT_CFG.mla.latent_dim
+    assert cache["k"].shape[-2] == 1
+    table = jnp.array([[2, 5, 7]], jnp.int32)
+    logits, cache = llama.prefill(
+        params, LATENT_CFG, tokens[:, :S_prompt], jnp.array([S_prompt]),
+        cache, table, dtype=DTYPE,
+    )
+    np.testing.assert_allclose(
+        logits[0], full[0, S_prompt - 1], rtol=2e-4, atol=2e-4
+    )
+    for t in range(S_prompt, S_total):
+        logits, cache = llama.decode_step(
+            params, LATENT_CFG, tokens[:, t], jnp.array([t]), cache, table,
+            active=jnp.array([True]), dtype=DTYPE,
+        )
+        np.testing.assert_allclose(
+            logits[0], full[0, t], rtol=3e-4, atol=3e-4,
+            err_msg=f"latent decode step at position {t}",
+        )
+
+
+def test_latent_cache_prefix_admission_matches_oracle(params):
+    """prefill_with_prefix over latent pages (tail attends the absorbed
+    form against cached latents) equals the oracle."""
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(8), (1, 12), 0, CFG.vocab_size
+    )
+    full = llama.forward_full(params, LATENT_CFG, tokens, dtype=DTYPE)
+    cache = llama.make_cache(LATENT_CFG, num_pages=8, page_size=4, dtype=DTYPE)
+    table = jnp.array([[0, 3, 6]], jnp.int32)
+    # Prefill the first 8, then admit the 4-token tail against the prefix.
+    _, cache = llama.prefill(
+        params, LATENT_CFG, tokens[:, :8], jnp.array([8]),
+        cache, table, dtype=DTYPE,
+    )
+    logits, cache = llama.prefill_with_prefix(
+        params, LATENT_CFG, tokens[:, 8:], jnp.array([8]), jnp.array([4]),
+        cache, table, dtype=DTYPE,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(full[0, 11]), rtol=3e-4, atol=3e-4
+    )
+
+
+def test_latent_engine_matches_materialized_engine():
+    """End to end: the serving engine with latent_cache generates the
+    SAME greedy tokens as the uncompressed-cache engine."""
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    outs = []
+    for model_cfg in (CFG, LATENT_CFG):
+        eng = Engine(
+            EngineConfig(
+                model="tiny-mla",
+                dtype=DTYPE,
+                num_pages=64,
+                page_size=8,
+                max_pages_per_seq=16,
+                max_batch_size=2,
+                prefill_buckets=(16,),
+            ),
+            model_cfg=model_cfg,
+        )
+        outs.append(eng.generate([[1, 2, 3, 4], [9, 8, 7]], None))
+    assert outs[0] == outs[1]
+
+
+def test_latent_engine_int8_quantized():
+    """Weight-only int8 under the latent cache: the absorbed path must
+    dequantize wukv before its per-head reshape (regression: QuantizedLinear
+    has no reshape)."""
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    eng = Engine(
+        EngineConfig(
+            model="tiny-mla",
+            dtype=DTYPE,
+            num_pages=64,
+            page_size=8,
+            max_pages_per_seq=16,
+            max_batch_size=2,
+            prefill_buckets=(16,),
+            quantize="int8",
+        ),
+        model_cfg=LATENT_CFG,
+    )
+    out = eng.generate([[1, 2, 3, 4]], None)
+    assert len(out) == 1 and len(out[0]) >= 1
