@@ -30,12 +30,21 @@ import sys
 import tempfile
 import time
 
-# The demo trains and serves on CPU deterministically (also usable on a
-# chip, but CPU keeps it hermetic for tests).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The demo trains and serves on CPU deterministically (hermetic for
+# tests; an image-level JAX_PLATFORMS pointing at a TPU plugin would
+# otherwise capture it). OPSAGENT_DEMO_PLATFORM overrides to run on a
+# chip. Both the env var AND the config update below are needed: a
+# TPU-plugin sitecustomize may have imported jax at interpreter boot,
+# freezing jax_platforms from the image env (see tests/conftest.py).
+_platform = os.environ.get("OPSAGENT_DEMO_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
@@ -48,16 +57,9 @@ KUBECTL_CMD = "kubectl get namespaces --no-headers | wc -l"
 FINAL_ANSWER = "There are 3 namespaces in the cluster."
 
 
-def build_dataset(tok):
-    """The two agent turns as (token_ids, loss_mask) training rows, built
-    with the SAME serialization code the live loop uses (tools.ToolPrompt,
-    chat_template.byte_template_ids) so serving-time prompts match the
-    training distribution byte for byte."""
-    from opsagent_tpu.serving.chat_template import byte_template_ids
-    from opsagent_tpu.serving.constrained import (
-        TOOLPROMPT_SCHEMA,
-        json_constraint,
-    )
+def build_convs():
+    """The two agent turns, serialized with the live loop's own wire code
+    (tools.ToolPrompt) — (messages, target reply) pairs."""
     from opsagent_tpu.tools import ToolAction, ToolPrompt
 
     user1 = f"Here are the instructions: {INSTRUCTION}"
@@ -83,7 +85,7 @@ def build_dataset(tok):
     )
     reply2 = tp2.to_json()
 
-    convs = [
+    return [
         ([{"role": "system", "content": SYS_PROMPT},
           {"role": "user", "content": user1}], reply1),
         ([{"role": "system", "content": SYS_PROMPT},
@@ -92,8 +94,58 @@ def build_dataset(tok):
           {"role": "user", "content": tp1_obs.to_json()}], reply2),
     ]
 
-    # Every training target must be REACHABLE under the ToolPrompt FSM the
-    # serving path enforces — otherwise the trained argmax fights the mask.
+
+def train_bpe_tokenizer(out_dir: str) -> str:
+    """Train a REAL byte-level-BPE tokenizer (HF fast-tokenizer format)
+    on the agent corpus and save it loadable via AutoTokenizer — the demo
+    then exercises the same HFTokenizer path real checkpoints use, not
+    the byte fallback. Returns the tokenizer dir."""
+    import json as jsonlib
+
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    from opsagent_tpu.serving.chat_template import render_llama3
+
+    corpus = []
+    for messages, reply in build_convs():
+        corpus.append(render_llama3(messages))
+        corpus.append(reply)
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=512, special_tokens=["<bos>", "<eos>", "<pad>"],
+        show_progress=False,
+        # Full byte alphabet: without it, bytes absent from the tiny
+        # corpus would be silently DROPPED at encode time (unk is None),
+        # so any later prompt/observation edit could train on a lossy
+        # target that the string-level FSM check cannot catch.
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(corpus, trainer)
+    tok_dir = os.path.join(out_dir, "tokenizer")
+    os.makedirs(tok_dir, exist_ok=True)
+    tok.save(os.path.join(tok_dir, "tokenizer.json"))
+    with open(os.path.join(tok_dir, "tokenizer_config.json"), "w",
+              encoding="utf-8") as f:
+        jsonlib.dump({
+            "tokenizer_class": "PreTrainedTokenizerFast",
+            "bos_token": "<bos>", "eos_token": "<eos>", "pad_token": "<pad>",
+        }, f)
+    return tok_dir
+
+
+def build_dataset(tok):
+    """(token_ids, loss_mask) rows: prompts rendered by the SAME
+    apply_chat_template the serving stack uses, targets validated
+    reachable under the ToolPrompt FSM the serving path enforces."""
+    from opsagent_tpu.serving.chat_template import apply_chat_template
+    from opsagent_tpu.serving.constrained import (
+        TOOLPROMPT_SCHEMA,
+        json_constraint,
+    )
+
+    convs = build_convs()
     con = json_constraint(tok, TOOLPROMPT_SCHEMA)
     for _, reply in convs:
         dfa = con.fsm.dfa
@@ -104,7 +156,7 @@ def build_dataset(tok):
 
     rows = []
     for messages, reply in convs:
-        prompt_ids = byte_template_ids(tok, messages)
+        prompt_ids = apply_chat_template(tok, messages)
         reply_ids = tok.encode(reply) + [tok.eos_id]
         ids = prompt_ids + reply_ids
         mask = [0.0] * len(prompt_ids) + [1.0] * len(reply_ids)
@@ -118,22 +170,38 @@ def main() -> int:
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--target-loss", type=float, default=0.01)
     ap.add_argument("--out", default="")
+    ap.add_argument("--tokenizer", default="bpe", choices=("bpe", "byte"),
+                    help="bpe = train a real HF fast tokenizer (the path "
+                         "real checkpoints use); byte = the test fallback")
     ap.add_argument("--skip-agent", action="store_true",
                     help="train + save only (no serving run)")
     args = ap.parse_args()
 
+    import dataclasses
+
     from opsagent_tpu.models.config import get_config_preset
     from opsagent_tpu.models.loader import save_checkpoint
     from opsagent_tpu.parallel.mesh import make_mesh
-    from opsagent_tpu.serving.tokenizer import ByteTokenizer
+    from opsagent_tpu.serving.tokenizer import ByteTokenizer, load_tokenizer
     from opsagent_tpu.training import (
         TrainConfig,
         init_train_state,
         make_train_step,
     )
 
+    out = args.out or tempfile.mkdtemp(prefix="opsagent-tiny-agent-")
+    os.makedirs(out, exist_ok=True)
     cfg = get_config_preset("tiny-test")
-    tok = ByteTokenizer(vocab_size=cfg.vocab_size)
+    if args.tokenizer == "bpe":
+        tok_path = train_bpe_tokenizer(out)
+        tok = load_tokenizer(tok_path)
+        # The lm head sizes to the trained vocab (specials included).
+        cfg = dataclasses.replace(cfg, vocab_size=tok.vocab_size)
+        print(f"bpe tokenizer: vocab {tok.vocab_size} at {tok_path}",
+              file=sys.stderr)
+    else:
+        tok_path = ""
+        tok = ByteTokenizer(vocab_size=cfg.vocab_size)
     rows = build_dataset(tok)
     S = 8 * ((max(len(ids) for ids, _ in rows) + 7) // 8)
     B = len(rows)
@@ -166,18 +234,16 @@ def main() -> int:
     print(f"trained to loss {loss:.4f} in {time.perf_counter()-t0:.0f}s",
           file=sys.stderr)
 
-    out = args.out or tempfile.mkdtemp(prefix="opsagent-tiny-agent-")
-    os.makedirs(out, exist_ok=True)
     ckpt = os.path.join(out, "model.safetensors")
     save_checkpoint(ckpt, params)
     print(f"checkpoint saved: {ckpt}", file=sys.stderr)
     if args.skip_agent:
         return 0
-    ok = run_agent(ckpt)
+    ok = run_agent(ckpt, tok_path, cfg)
     return 0 if ok else 1
 
 
-def run_agent(ckpt: str) -> bool:
+def run_agent(ckpt: str, tok_path: str, cfg) -> bool:
     """Serve the trained checkpoint and run the real agent loop on it."""
     from opsagent_tpu.agent.react import assistant_with_config
     from opsagent_tpu.serving import api as serving_api
@@ -187,16 +253,20 @@ def run_agent(ckpt: str) -> bool:
 
     install_replay_kubectl()
 
-    engine = Engine(EngineConfig(
-        model="tiny-test",
-        checkpoint=ckpt,
-        dtype=jnp.float32,
-        num_pages=512,
-        page_size=16,
-        max_pages_per_seq=64,
-        max_batch_size=2,
-        prefill_buckets=(128, 512, 1024),
-    ))
+    engine = Engine(
+        EngineConfig(
+            model="tiny-test",
+            checkpoint=ckpt,
+            tokenizer=tok_path,
+            dtype=jnp.float32,
+            num_pages=512,
+            page_size=16,
+            max_pages_per_seq=64,
+            max_batch_size=2,
+            prefill_buckets=(128, 512, 1024),
+        ),
+        model_cfg=cfg,
+    )
     stack = serving_api.ServingStack(engine)
     serving_api.install_stack("tiny-agent", stack)
     try:
