@@ -38,7 +38,10 @@ import time
 # freezing jax_platforms from the image env (see tests/conftest.py).
 _platform = os.environ.get("OPSAGENT_DEMO_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if _platform == "cpu":
+    # Hermetic mode must never touch a pooled TPU; a chip run keeps the
+    # pool connection alive.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
@@ -192,6 +195,14 @@ def main() -> int:
     out = args.out or tempfile.mkdtemp(prefix="opsagent-tiny-agent-")
     os.makedirs(out, exist_ok=True)
     cfg = get_config_preset("tiny-test")
+    if args.tokenizer == "bpe":
+        try:
+            import tokenizers  # noqa: F401 - probe the optional dep
+            import transformers  # noqa: F401
+        except ImportError as e:
+            print(f"tokenizers/transformers unavailable ({e}); "
+                  f"falling back to the byte tokenizer", file=sys.stderr)
+            args.tokenizer = "byte"
     if args.tokenizer == "bpe":
         tok_path = train_bpe_tokenizer(out)
         tok = load_tokenizer(tok_path)

@@ -325,3 +325,84 @@ def test_latent_engine_int8_quantized():
     )
     out = eng.generate([[1, 2, 3, 4]], None)
     assert len(out) == 1 and len(out[0]) >= 1
+
+
+def test_v3_shaped_moe_mla_checkpoint_roundtrip(tmp_path):
+    """A scaled-down DeepSeek-V3-shaped config (MLA + q_lora + sigmoid
+    noaux_tc MoE with router_bias + shared expert) must roundtrip through
+    the HF naming (kv_a_proj_with_mqa, e_score_correction_bias, experts)
+    with identical logits."""
+    from opsagent_tpu.models.config import MLAConfig, MoEConfig
+    from opsagent_tpu.models.loader import load_checkpoint, save_checkpoint
+
+    cfg = dataclasses.replace(
+        get_config_preset("tiny-mla"),
+        num_layers=3,
+        moe=MoEConfig(
+            num_experts=4,
+            num_experts_per_token=2,
+            num_shared_experts=1,
+            expert_intermediate_size=32,
+            norm_topk_prob=True,
+            routed_scaling_factor=2.5,
+            scoring_func="sigmoid",
+            n_group=2,
+            topk_group=1,
+        ),
+        moe_layer_start=1,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(3), dtype=DTYPE)
+    # Non-zero selection bias so the roundtrip must preserve it to keep
+    # routing identical.
+    params["moe_layers"]["router_bias"] = jnp.asarray(
+        np.linspace(-1, 1, 2 * 4).reshape(2, 4), jnp.float32
+    )
+    ckpt = tmp_path / "model.safetensors"
+    save_checkpoint(str(ckpt), params, cfg=cfg)
+    loaded = load_checkpoint(str(ckpt), cfg, dtype=DTYPE)
+    tokens = jnp.array([[5, 6, 7, 8, 9, 10]], jnp.int32)
+    l1 = llama.forward_full(params, cfg, tokens, dtype=DTYPE)
+    l2 = llama.forward_full(loaded, cfg, tokens, dtype=DTYPE)
+    np.testing.assert_allclose(
+        np.asarray(l1), np.asarray(l2), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_latent_engine_prefix_cache_reuse():
+    """Latent pages participate in the prefix cache: a second request
+    sharing a prompt prefix gets cache hits and identical greedy output."""
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+    from opsagent_tpu.utils.perf import get_perf_stats
+
+    eng = Engine(
+        EngineConfig(
+            model="tiny-mla",
+            dtype=DTYPE,
+            num_pages=64,
+            page_size=4,
+            max_pages_per_seq=16,
+            max_batch_size=2,
+            prefill_buckets=(16,),
+        ),
+        model_cfg=LATENT_CFG,
+    )
+    prompt = list(range(1, 13))  # 12 tokens = 3 full pages
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    get_perf_stats().reset()
+    sid1 = eng.add_request(list(prompt), sp)
+    out1 = []
+    while not eng.sequences[sid1].done and len(out1) < 4:
+        out1 += eng.step_block([sid1]).get(sid1, [])
+    out1 += [t for v in eng.drain().values() for t in v]
+    eng.finish(sid1)  # donates full pages to the prefix trie
+
+    sid2 = eng.add_request(list(prompt), sp)
+    stats = get_perf_stats().get_stats()
+    hits = stats.get("engine.prefix_hit_tokens", {}).get("count", 0)
+    assert hits >= 1, stats.keys()
+    out2 = []
+    while not eng.sequences[sid2].done and len(out2) < 4:
+        out2 += eng.step_block([sid2]).get(sid2, [])
+    out2 += [t for v in eng.drain().values() for t in v]
+    assert out1[:4] == out2[:4]
