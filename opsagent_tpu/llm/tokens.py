@@ -36,6 +36,15 @@ DEFAULT_TOKEN_LIMIT = 4096
 def get_token_limits(model: str) -> int:
     m = model.lower()
     if m.startswith("tpu://"):
+        # In-tree models: the preset's max_position is authoritative (the
+        # engine REJECTS prompts beyond it at admission, so the agent-side
+        # constrictor must budget against the same number). models.config
+        # is dataclass-only — no jax import cost on the agent CLI path.
+        from ..models.config import PRESETS
+
+        preset = PRESETS.get(m[len("tpu://"):])
+        if preset is not None:
+            return preset.max_position
         m = "tpu"
     best = 0
     limit = DEFAULT_TOKEN_LIMIT

@@ -280,6 +280,9 @@ QWEN25_7B = _register(
         rope_theta=1000000.0,
         attn_bias=True,
         rms_norm_eps=1e-6,
+        # Native window per the HF config (YaRN x4 to 128k is an opt-in
+        # config edit upstream; add rope_scaling here to enable it).
+        max_position=32768,
     )
 )
 
@@ -295,11 +298,13 @@ QWEN25_72B = _register(
         rope_theta=1000000.0,
         attn_bias=True,
         rms_norm_eps=1e-6,
+        # Native window per the HF config (YaRN x4 to 128k is an opt-in
+        # config edit upstream; add rope_scaling here to enable it).
+        max_position=32768,
     )
 )
 
-# DeepSeek-V2-lite-style MoE (stand-in for the DeepSeek function-calling
-# config; V3's MLA attention lands with the MoE milestone).
+# DeepSeek-MoE-16B (GQA + MoE; the pre-MLA DeepSeek generation).
 DEEPSEEK_MOE_16B = _register(
     ModelConfig(
         name="deepseek-moe-16b",

@@ -59,3 +59,17 @@ def test_constrict_prompt_single_long_line():
 
 def test_constrict_prompt_small_input_unchanged():
     assert constrict_prompt("short", 100) == "short"
+
+
+def test_tpu_model_limit_follows_preset_window():
+    """tpu://<preset> budgets against the preset's max_position — the
+    same number the engine enforces at admission — not the generic table."""
+    from opsagent_tpu.llm.tokens import get_token_limits
+    from opsagent_tpu.models.config import get_config_preset
+
+    for name in ("qwen2.5-7b-instruct", "llama-3-8b-instruct", "tiny-test"):
+        assert get_token_limits(f"tpu://{name}") == (
+            get_config_preset(name).max_position
+        )
+    # Unknown tpu targets keep the generic tpu window.
+    assert get_token_limits("tpu://custom-model") == 131072
