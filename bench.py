@@ -163,7 +163,20 @@ def run_orchestrated() -> None:
         return r
 
     stage1_cap = float(os.environ.get("OPSAGENT_BENCH_STAGE1_CAP", "390"))
-    r1 = stage({}, 0, "default", cap=stage1_cap)
+    # Whatever the budget, stage 1 must leave room for the cpu fallback
+    # (its cap is 180s + child startup): a wedged-device kill at the full
+    # stage-1 cap must never eat the guaranteed-line stage too. Budgets
+    # too small to fit both skip the device stage entirely.
+    FALLBACK_RESERVE = 220.0
+    if remaining() - FALLBACK_RESERVE >= 60.0:
+        r1 = stage(
+            {}, 0, "default",
+            cap=min(stage1_cap, remaining() - FALLBACK_RESERVE),
+        )
+    else:
+        log(f"bench: {remaining():.0f}s budget cannot fit a device stage "
+            f"plus the fallback; running cpu-pinned only")
+        r1 = None
     if r1 is None:
         # Device unreachable or preset wedged: a cpu-pinned child (no TPU
         # plugin) still proves the stack end to end and guarantees the

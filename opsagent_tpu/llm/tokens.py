@@ -23,7 +23,9 @@ TOKEN_LIMITS: dict[str, int] = {
     "gpt-3.5-turbo": 16384,
     "qwen-plus": 131072,
     "qwen-turbo": 131072,
-    "qwen2.5": 131072,
+    # Native window (the in-tree presets enforce it at admission; YaRN
+    # to 128k is an upstream opt-in config edit).
+    "qwen2.5": 32768,
     "deepseek": 65536,
     "llama-3": 8192,
     "llama3": 8192,

@@ -77,13 +77,14 @@ def test_wedged_child_killed_and_fallback_lands(tmp_path):
         "        and not os.environ.get('OPSAGENT_BENCH_MODEL')):\n"
         "    time.sleep(3600)\n"
     )
+    # No explicit stage-1 cap: the orchestrator's fallback RESERVE must
+    # clamp it (budget 300 -> cap 80), so the wedged child is killed with
+    # enough budget left for the cpu fallback to land its line — the
+    # regression where a full 390s cap ate the whole budget and the
+    # "guaranteed" stage was skipped.
     out = _run_bench({
         "PYTHONPATH": f"{tmp_path}{os.pathsep}{REPO}",
-        "OPSAGENT_BENCH_BUDGET": "280",
-        # Above _run_child's 60s too-little-time floor, so the child truly
-        # starts, hangs, and gets KILLED at the cap (the code path under
-        # test); the fallback then runs within the remaining budget.
-        "OPSAGENT_BENCH_STAGE1_CAP": "65",
+        "OPSAGENT_BENCH_BUDGET": "300",
         "OPSAGENT_BENCH_BATCH": "2",
         "OPSAGENT_BENCH_STEPS": "8",
     }, timeout=420)
@@ -93,3 +94,19 @@ def test_wedged_child_killed_and_fallback_lands(tmp_path):
     parsed = json.loads(lines[-1])
     assert parsed["extra"]["platform"] == "cpu"
     assert "cpu fallback" in parsed["extra"].get("note", "")
+
+
+def test_tiny_budget_goes_straight_to_fallback():
+    """A budget too small for device-stage + fallback skips the device
+    stage entirely and still produces the guaranteed line."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_BUDGET": "120",
+        "OPSAGENT_BENCH_BATCH": "2",
+        "OPSAGENT_BENCH_STEPS": "8",
+    }, timeout=300)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-1500:]
+    assert "cpu-pinned only" in out.stderr
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["unit"] == "tok/s/chip"
