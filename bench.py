@@ -145,8 +145,15 @@ def run_orchestrated() -> None:
     def remaining() -> float:
         return budget - (time.perf_counter() - t_start)
 
-    # None-valued entries REMOVE inherited vars (see _run_child).
-    base = {"OPSAGENT_BENCH_SPEC": None, "OPSAGENT_BENCH_MODE": None}
+    # None-valued entries REMOVE inherited vars (see _run_child): an
+    # operator-exported spec/mode/backend var must not contaminate the
+    # stages it doesn't belong to (the pallas-dma stage is compared
+    # against stage 1's xla default).
+    base = {
+        "OPSAGENT_BENCH_SPEC": None,
+        "OPSAGENT_BENCH_MODE": None,
+        "OPSAGENT_PAGED_BACKEND": None,
+    }
 
     def stage(env_extra: dict, min_remaining: float, tag: str,
               cap: float | None = None) -> dict | None:
@@ -168,28 +175,29 @@ def run_orchestrated() -> None:
     # stage-1 cap must never eat the guaranteed-line stage too. Budgets
     # too small to fit both skip the device stage entirely.
     FALLBACK_RESERVE = 220.0
+    note = ""
     if remaining() - FALLBACK_RESERVE >= 60.0:
         r1 = stage(
             {}, 0, "default",
             cap=min(stage1_cap, remaining() - FALLBACK_RESERVE),
         )
+        if r1 is None:
+            note = "cpu fallback: tpu device unreachable during bench window"
     else:
         log(f"bench: {remaining():.0f}s budget cannot fit a device stage "
             f"plus the fallback; running cpu-pinned only")
         r1 = None
+        note = "cpu-pinned only: budget too small for device stage + fallback"
     if r1 is None:
-        # Device unreachable or preset wedged: a cpu-pinned child (no TPU
-        # plugin) still proves the stack end to end and guarantees the
-        # driver a parsed line.
-        log("bench: default preset failed; falling back to cpu-pinned run")
+        # Device unreachable, preset wedged, or budget too small: a
+        # cpu-pinned child (no TPU plugin) still proves the stack end to
+        # end and guarantees the driver a parsed line.
         r1 = stage(
             {**_cpu_env(), "OPSAGENT_BENCH_MODEL": "tiny-test"},
             0, "cpu-fallback", cap=180.0,
         )
         if r1 is not None:
-            r1.setdefault("extra", {})["note"] = (
-                "cpu fallback: tpu device unreachable during bench window"
-            )
+            r1.setdefault("extra", {})["note"] = note
     platform = (r1 or {}).get("extra", {}).get("platform", "")
     headline = r1
 
@@ -215,6 +223,14 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_SPEC": str(SPEC_K)},
         180, "spec",
     ) if on_tpu else None
+    # Kernel comparison (PERF.md plan item 2): the manual-DMA Pallas
+    # paged-attention backend on the same 1B preset; value vs stage 1
+    # (xla gather) decides the default (ops/attention.py).
+    rdma = stage(
+        {"OPSAGENT_BENCH_MODEL": "bench-1b",
+         "OPSAGENT_PAGED_BACKEND": "pallas-dma"},
+        150, "pallas-dma",
+    ) if on_tpu else None
 
     if headline is None:
         log("bench: no preset produced a number")
@@ -230,6 +246,8 @@ def run_orchestrated() -> None:
         )
     if rspec is not None:
         extra[f"spec{SPEC_K}_overhead_tok_s_chip"] = rspec["value"]
+    if rdma is not None:
+        extra["pallas_dma_tok_s_chip"] = rdma["value"]
     out = dict(headline, extra=extra)
     print(json.dumps(out), flush=True)
 
@@ -376,6 +394,7 @@ def run_single() -> None:
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
+            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
         },
     }), flush=True)
 
@@ -470,6 +489,7 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
+            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
         },
     }), flush=True)
     stack.close()
