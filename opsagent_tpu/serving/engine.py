@@ -1678,6 +1678,10 @@ class Engine:
                 "engine.block_dispatch", (time.perf_counter() - t_disp) * 1e3,
                 "ms",
             )
+            if speculate:
+                # Observability for the speculative path (also the signal
+                # tests use to prove speculation actually engaged).
+                perf.record_metric("engine.spec_blocks", 1, "blk")
             self._inflight.append((toks, lane_seqs, budgets, counts))
             for sid, b in zip(lane_seqs, budgets):
                 if sid is not None and b:
