@@ -2,9 +2,10 @@
 MLA paths): decode/prefill consistency against the all-positions oracle,
 HF-name checkpoint roundtrip, tensor parallelism, and serving.
 
-MLA is served in the uncompressed-cache form: k/v materialized per head,
-v zero-padded to the qk head dim so the shared paged-cache machinery is
-untouched (see config.MLAConfig docstring).
+MLA serves in two layouts (config.MLAConfig): uncompressed per-head k/v
+(v zero-padded to the qk head dim so the shared paged-cache machinery is
+untouched) and the compressed latent cache with weight-absorbed decode;
+both are oracle-tested here.
 """
 
 import dataclasses
