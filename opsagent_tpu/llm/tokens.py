@@ -38,13 +38,27 @@ DEFAULT_TOKEN_LIMIT = 4096
 def get_token_limits(model: str) -> int:
     m = model.lower()
     if m.startswith("tpu://"):
-        # In-tree models: the preset's max_position is authoritative (the
-        # engine REJECTS prompts beyond it at admission, so the agent-side
-        # constrictor must budget against the same number). models.config
-        # is dataclass-only — no jax import cost on the agent CLI path.
+        # In-tree models: the engine's max_position is authoritative (it
+        # REJECTS prompts beyond it at admission, so the agent-side
+        # constrictor must budget against the same number). Stacks are
+        # installed under ARBITRARY names (tpu://real, tpu://tiny-agent),
+        # so ask the installed-stack registry first — but only if the
+        # serving module is already loaded: importing it costs a jax
+        # import the agent CLI path must not pay, and if it was never
+        # imported no stack can be installed in this process anyway.
+        import sys
+
+        name = m[len("tpu://"):]
+        api_mod = sys.modules.get("opsagent_tpu.serving.api")
+        if api_mod is not None:
+            # The registry lookup is case-insensitive on its side.
+            installed = api_mod.installed_stack_max_position(name)
+            if installed is not None:
+                return installed
+        # Fall back to the preset table (dataclass-only, no jax import).
         from ..models.config import PRESETS
 
-        preset = PRESETS.get(m[len("tpu://"):])
+        preset = PRESETS.get(name)
         if preset is not None:
             return preset.max_position
         m = "tpu"

@@ -523,6 +523,29 @@ def uninstall_stack(name: str) -> None:
         _stacks.pop(name, None)
 
 
+def installed_stack_max_position(name: str) -> int | None:
+    """Context window of an ALREADY-installed stack, or None.
+
+    Unlike get_stack this never constructs an engine: it exists so the
+    agent-side token constrictor (llm/tokens.py) can budget against the
+    exact window the engine enforces at admission (engine.add_request
+    rejects prompts >= max_position) for stacks installed under arbitrary
+    names like tpu://real or tpu://tiny-agent, without triggering a
+    device-resident engine build on a lookup."""
+    with _stacks_lock:
+        stack = _stacks.get(name)
+        if stack is None:
+            # Case-insensitive rescue: tokens.py lowercases model names,
+            # but install_stack keeps the caller's case.
+            low = name.lower()
+            stack = next(
+                (s for k, s in _stacks.items() if k.lower() == low), None
+            )
+    if stack is None:
+        return None
+    return int(stack.engine.model_cfg.max_position)
+
+
 def get_stack(name: str) -> ServingStack:
     # Engine construction happens under the lock: two racing first requests
     # must not each build a device-resident engine (the loser would leak

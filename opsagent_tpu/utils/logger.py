@@ -131,14 +131,37 @@ class DailyRotatingFileHandler(logging.handlers.RotatingFileHandler):
 
     def prune(self) -> None:
         """Delete log artifacts older than retention_days (lumberjack
-        MaxAge equivalent; <= 0 means never expire, as MaxAge=0 does)."""
-        import glob
+        MaxAge equivalent; <= 0 means never expire, as MaxAge=0 does).
+
+        Only files THIS handler writes are eligible: the date-stamped
+        daily file plus its .N size-rollover / .gz compression suffixes.
+        A bare prefix glob would also match unrelated same-prefix logs
+        (e.g. opsagent-http.log next to opsagent.log) and delete another
+        subsystem's data once it aged past retention. listdir+regex
+        rather than glob: a log dir containing glob metacharacters
+        ("logs[prod]/") would silently match nothing and disable
+        retention."""
+        import re
 
         if self._retention <= 0:
             return
         root, ext = os.path.splitext(self._base)
+        own = re.compile(
+            re.escape(os.path.basename(root))
+            + r"-\d{4}-\d{2}-\d{2}"
+            + re.escape(ext)
+            + r"(\.\d+)?(\.gz)?$"
+        )
         cutoff = time.time() - self._retention * 86400.0
-        for p in glob.glob(f"{root}-*{ext}*"):
+        logdir = os.path.dirname(self._base) or "."
+        try:
+            entries = os.listdir(logdir)
+        except OSError:
+            return
+        for fname in entries:
+            if not own.fullmatch(fname):
+                continue
+            p = os.path.join(logdir, fname)
             try:
                 if os.path.getmtime(p) < cutoff:
                     os.remove(p)

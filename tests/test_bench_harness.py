@@ -110,3 +110,25 @@ def test_tiny_budget_goes_straight_to_fallback():
     lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
     parsed = json.loads(lines[-1])
     assert parsed["unit"] == "tok/s/chip"
+
+
+def test_vs_baseline_null_unless_tpu_and_8b_class():
+    """VERDICT r03: a cpu-fallback line carried vs_baseline 2.929 and
+    read as a target hit. The ratio must be null unless the number is
+    (a) measured on tpu AND (b) from a baseline-class (8B) model."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    assert bench.vs_baseline(5858.9, "tiny-test", "cpu") is None
+    assert bench.vs_baseline(187.6, "bench-1b", "tpu") is None  # not 8B-class
+    assert bench.vs_baseline(2100.0, "bench-8b", "cpu") is None
+    assert bench.vs_baseline(2100.0, "bench-8b", "tpu") == 1.05
+    assert bench.vs_baseline(500.0, "llama-3-8b-instruct", "tpu") == 0.25
+    # json.dumps renders the None as null, never a number.
+    assert json.dumps({"vs_baseline": bench.vs_baseline(1.0, "x", "cpu")}) \
+        == '{"vs_baseline": null}'

@@ -73,3 +73,27 @@ def test_tpu_model_limit_follows_preset_window():
         )
     # Unknown tpu targets keep the generic tpu window.
     assert get_token_limits("tpu://custom-model") == 131072
+
+
+def test_tpu_model_limit_follows_installed_stack():
+    """ADVICE r03 (medium): stacks are installed under ARBITRARY names
+    (tpu://real, tpu://tiny-agent). The constrictor must budget against
+    the installed engine's max_position — the number admission enforces —
+    not the generic 131072 'tpu' fallback, or long agent histories get
+    hard-rejected instead of constricted."""
+    from types import SimpleNamespace
+
+    from opsagent_tpu.llm.tokens import get_token_limits
+    from opsagent_tpu.serving import api
+
+    fake = SimpleNamespace(
+        engine=SimpleNamespace(model_cfg=SimpleNamespace(max_position=8192))
+    )
+    api.install_stack("real", fake)
+    try:
+        assert get_token_limits("tpu://real") == 8192
+        assert get_token_limits("tpu://REAL") == 8192  # case-tolerant
+    finally:
+        api.uninstall_stack("real")
+    # Back to the generic fallback once uninstalled.
+    assert get_token_limits("tpu://real") == 131072

@@ -117,13 +117,23 @@ def test_logger_daily_rotation(tmp_path):
         lines = [json.loads(ln) for ln in f]
     assert any(e["msg"] == "day two" for e in lines)
 
-    # Retention: a file stamped old enough gets pruned.
+    # Retention: a file stamped old enough gets pruned — but ONLY this
+    # handler's date-stamped artifacts. An unrelated same-prefix log
+    # (ADVICE r03: opsagent-http.log next to opsagent.log) must survive
+    # even when older than retention.
     stale = tmp_path / "opsagent-2000-01-01.log"
     stale.write_text("old\n")
+    stale_gz = tmp_path / "opsagent-2000-01-01.log.2.gz"
+    stale_gz.write_text("old backup\n")
+    other = tmp_path / "opsagent-http.log"
+    other.write_text("another subsystem\n")
     old = time.time() - 30 * 86400
-    os.utime(stale, (old, old))
+    for p in (stale, stale_gz, other):
+        os.utime(p, (old, old))
     h.prune()
     assert not stale.exists()
+    assert not stale_gz.exists()
+    assert other.exists()
     h.close()
 
 
