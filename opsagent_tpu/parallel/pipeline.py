@@ -62,6 +62,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models import llama
 from ..models.config import ModelConfig
+from .ring import _local_ring_attention
 
 
 def param_specs_pp(cfg: ModelConfig) -> Any:
@@ -103,6 +104,7 @@ def make_pipeline_loss(
     L %% PP == 0 / (MoE models) (L - moe_layer_start) %% PP == 0.
     """
     PP = mesh.shape["pp"]
+    SP = mesh.shape["sp"]
     M = microbatches
     is_moe = cfg.moe is not None
     Ld, Lm = llama._layer_split(cfg)
@@ -117,14 +119,23 @@ def make_pipeline_loss(
         )
 
     def run_stage(stage_params: Any, x: jax.Array, cos, sin):
-        """Run a slice of the stack; returns (x, summed MoE aux)."""
+        """Run a slice of the stack; returns (x, summed MoE aux).
+
+        With sp > 1 the stage's sequence dim is the LOCAL shard and
+        attention runs the sp-axis ppermute ring (parallel/ring.py) —
+        pipeline stages and the ring compose because both are manual
+        axes of the same shard_map."""
         mb, S = x.shape[:2]
 
         def attn_fn(h, lp, kc, vc, li):
             q, k, v = llama._qkv_rope(h, lp, cfg, cos, sin)
-            from ..ops.attention import causal_prefill_attention
+            if SP > 1:
+                lengths = jnp.full((mb,), S * SP, jnp.int32)
+                attn = _local_ring_attention(q, k, v, lengths, axis="sp")
+            else:
+                from ..ops.attention import causal_prefill_attention
 
-            attn = causal_prefill_attention(q, k, v)
+                attn = causal_prefill_attention(q, k, v)
             return attn.reshape(mb, S, -1), kc, vc
 
         x, _, aux = llama._run_stack(
@@ -145,7 +156,10 @@ def make_pipeline_loss(
         stage = jax.lax.axis_index("pp")
         is_last = stage == PP - 1
 
-        positions = jnp.arange(S)[None, :].repeat(mb, axis=0)
+        # Positions are GLOBAL: with sp > 1 this stage sees the local
+        # sequence shard [sp_idx*S, (sp_idx+1)*S) of the full sequence.
+        sp_idx = jax.lax.axis_index("sp")
+        positions = (sp_idx * S + jnp.arange(S))[None, :].repeat(mb, axis=0)
         from ..ops.rope import rope_table
 
         cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta,
@@ -162,13 +176,13 @@ def make_pipeline_loss(
         # holds different activations); the varying-manual-axes type must
         # match between scan input and output.
         outs0 = jax.lax.pcast(
-            jnp.zeros((M, mb, S, d), dtype), ("pp", "dp"), to="varying"
+            jnp.zeros((M, mb, S, d), dtype), ("pp", "dp", "sp"), to="varying"
         )
         reg0 = jax.lax.pcast(
-            jnp.zeros((mb, S, d), dtype), ("pp", "dp"), to="varying"
+            jnp.zeros((mb, S, d), dtype), ("pp", "dp", "sp"), to="varying"
         )  # pipeline register
         aux0 = jax.lax.pcast(
-            jnp.zeros((), jnp.float32), ("pp", "dp"), to="varying"
+            jnp.zeros((), jnp.float32), ("pp", "dp", "sp"), to="varying"
         )
 
         def tick(carry, t):
@@ -226,26 +240,51 @@ def make_pipeline_loss(
         x = outs.reshape(B, S, d)
         x = llama.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
         logits = llama._lm_head(params, cfg, x)
-        logz = jax.nn.logsumexp(logits[:, :-1], axis=-1)
-        gold = jnp.take_along_axis(
-            logits[:, :-1], tokens[:, 1:][..., None], axis=-1
-        )[..., 0]
-        msk = loss_mask[:, 1:].astype(jnp.float32)
+        if SP > 1:
+            # Next-token shift ACROSS the sp shard boundary: the target
+            # of this shard's last position is the NEXT shard's first
+            # token, fetched with one ppermute (the true last global
+            # position has no target and is masked out).
+            shift = [(j, j - 1) for j in range(1, SP)]
+            nxt_tok = jax.lax.ppermute(tokens[:, :1], "sp", shift)
+            nxt_msk = jax.lax.ppermute(
+                loss_mask[:, :1].astype(jnp.float32), "sp", shift
+            )
+            last_shard = sp_idx == SP - 1
+            targets = jnp.concatenate([tokens[:, 1:], nxt_tok], axis=1)
+            msk = jnp.concatenate(
+                [loss_mask[:, 1:].astype(jnp.float32),
+                 jnp.where(last_shard, 0.0, nxt_msk)], axis=1
+            )
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, targets[..., None], axis=-1
+            )[..., 0]
+        else:
+            logz = jax.nn.logsumexp(logits[:, :-1], axis=-1)
+            gold = jnp.take_along_axis(
+                logits[:, :-1], tokens[:, 1:][..., None], axis=-1
+            )[..., 0]
+            msk = loss_mask[:, 1:].astype(jnp.float32)
         nll_sum = jnp.sum((logz - gold) * msk)
         tok_cnt = jnp.sum(msk)
         sums = jnp.where(
             is_last, jnp.stack([nll_sum, tok_cnt]), jnp.zeros((2,))
         )
-        # Global token-mean: over the pipeline (pick the last stage's sums)
-        # AND over dp shards (each saw its own batch slice).
-        sums = jax.lax.psum(sums, ("pp", "dp"))
+        # Global token-mean: over the pipeline (pick the last stage's
+        # sums), dp shards (each saw its own batch slice), and sp shards
+        # (each saw its own sequence slice).
+        sums = jax.lax.psum(sums, ("pp", "dp", "sp"))
         ce = sums[0] / jnp.maximum(sums[1], 1.0)
         # Aux back to the non-pipelined scale: each of the M microbatches
         # contributed its own per-layer routing stats (vs ONE whole-batch
-        # stat in the unpipelined step), and dp shards each counted their
-        # slice — mean over both.
+        # stat in the unpipelined step), and dp/sp shards each counted
+        # their slice — mean over all of them.
         dp_size = jax.lax.axis_size("dp")
-        aux = jax.lax.psum(aux_acc, ("pp", "dp")) / (M * dp_size)
+        sp_size = jax.lax.axis_size("sp")
+        aux = jax.lax.psum(aux_acc, ("pp", "dp", "sp")) / (
+            M * dp_size * sp_size
+        )
         return ce + moe_aux_weight * aux, (ce, aux)
 
     base_specs = llama.param_specs(cfg)
@@ -270,16 +309,18 @@ def make_pipeline_loss(
     if not cfg.tie_embeddings:
         param_in_specs["lm_head"] = P()
 
-    # Manual over pp AND dp (tp/sp stay on GSPMD auto-sharding inside the
-    # stage): dp must be manual here because XLA's SPMD partitioner cannot
-    # yet mix an auto dp batch dimension with manual-pp collectives (its
-    # AllReduceAlongShardingDims hits a device-group CHECK). Manual dp is
-    # the same math — shard_map's transpose inserts the gradient psum over
-    # dp for the replicated params, exactly what GSPMD would emit.
+    # Manual over pp, dp AND sp (tp/ep stay on GSPMD auto-sharding inside
+    # the stage): dp must be manual here because XLA's SPMD partitioner
+    # cannot yet mix an auto dp batch dimension with manual-pp collectives
+    # (its AllReduceAlongShardingDims hits a device-group CHECK); sp is
+    # manual so the stage can run the ring-attention ppermute over it.
+    # Manual dp/sp is the same math — shard_map's transpose inserts the
+    # gradient psum for the replicated params, exactly what GSPMD would
+    # emit.
     return jax.shard_map(
         pipelined,
         mesh=mesh,
-        in_specs=(param_in_specs, P("dp"), P("dp")),
+        in_specs=(param_in_specs, P("dp", "sp"), P("dp", "sp")),
         out_specs=(P(), (P(), P())),
-        axis_names={"pp", "dp"},
+        axis_names={"pp", "dp", "sp"},
     )

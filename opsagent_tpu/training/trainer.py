@@ -142,16 +142,10 @@ def make_train_step(
 
     if mesh.shape.get("pp", 1) > 1:
         # GPipe microbatch pipeline over the pp axis (parallel/pipeline.py);
-        # params must carry param_specs_pp (init_train_state does).
-        if tc.ring_attention or mesh.shape.get("sp", 1) > 1:
-            # Refuse rather than silently dropping the knob: the pipeline
-            # stage runs full-sequence attention (no sp sharding), so a
-            # long-context pp+sp run would OOM without warning.
-            raise NotImplementedError(
-                "pp>1 does not yet compose with sp>1 / ring_attention; "
-                "use sp on a pp=1 mesh or pipeline without sequence "
-                "parallelism"
-            )
+        # params must carry param_specs_pp (init_train_state does). With
+        # sp > 1 the stage runs ring attention over the sp axis inside
+        # the pipeline's own shard_map (pp x sp composition — long-context
+        # training across pipeline stages).
         from ..parallel.pipeline import make_pipeline_loss
 
         loss_fn = make_pipeline_loss(

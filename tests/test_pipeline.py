@@ -189,3 +189,58 @@ def test_pp_moe_specs_stage_layer_axis():
     assert specs["layers"]["wq"][0] is None or "pp" not in str(
         specs["layers"]["wq"][0]
     )  # dense prefix replicated over pp
+
+
+def test_pp2_sp2_ring_matches_pp1_oracle():
+    """pp x sp composition (VERDICT r03 missing #3): a pp=2 x sp=2 mesh —
+    ring attention over the sp axis INSIDE each pipeline stage — must
+    match the unpipelined unsharded oracle exactly: pipelining is a
+    re-scheduling and the ring is a re-layout of the same math, including
+    the next-token shift across the sp shard boundary."""
+    tc = TrainConfig(
+        learning_rate=1e-3, remat=False, pp_microbatches=2,
+        ring_attention=True,
+    )
+    tokens, mask = _data(B=2, S=32)
+    # Mask out a few positions so the cross-boundary mask shift is
+    # exercised with a non-trivial pattern.
+    mask = mask.at[:, :3].set(0.0)
+
+    mesh1 = make_mesh(tp=2, dp=1, sp=1)          # plain oracle
+    p1, o1 = init_train_state(
+        CFG, tc, mesh1, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    step1 = make_train_step(CFG, tc, mesh1, dtype=jnp.float32)
+    p1, o1, m1 = step1(p1, o1, tokens, mask)
+
+    mesh2 = make_mesh(pp=2, dp=1, sp=2, tp=2)    # pipelined + ring
+    p2, o2 = init_train_state(
+        CFG, tc, mesh2, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    step2 = make_train_step(CFG, tc, mesh2, dtype=jnp.float32)
+    p2, o2, m2 = step2(p2, o2, tokens, mask)
+
+    assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-5
+    import numpy as np
+
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        # device_get first: the two meshes span different device sets.
+        assert np.allclose(
+            jax.device_get(a), jax.device_get(b), atol=1e-4
+        ), (a.shape, b.shape)
+
+
+def test_pp2_sp2_dp2_composes():
+    """Full pp x dp x sp x tp mesh (8 virtual devices, every axis real):
+    the step executes and produces a finite loss."""
+    tc = TrainConfig(learning_rate=1e-3, remat=True, pp_microbatches=2,
+                     ring_attention=True)
+    tokens, mask = _data(B=4, S=32)
+    mesh = make_mesh(pp=2, dp=2, sp=2, tp=1)
+    p, o = init_train_state(
+        CFG, tc, mesh, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    step = make_train_step(CFG, tc, mesh, dtype=jnp.float32)
+    p, o, m = step(p, o, tokens, mask)
+    loss = float(m["loss"])
+    assert loss == loss and loss < 1e9
