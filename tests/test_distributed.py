@@ -1,0 +1,104 @@
+"""Multi-host DCN smoke (VERDICT r03 stretch #10): two OS processes join
+one JAX runtime through ``parallel.mesh.init_distributed`` (the env-var
+path a real TPU pod uses), build a mesh spanning BOTH processes'
+devices, and run a jitted computation whose all-reduce crosses the
+process boundary — proving the DCN half of the comm backend executes,
+not just imports.
+
+On TPU pods the same ``jax.distributed.initialize`` call rides the pod
+metadata and the collectives ride ICI/DCN; here each process hosts two
+virtual CPU devices and the collective rides the distributed runtime's
+TCP transport — same code path in this framework, different PJRT wire.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from opsagent_tpu.parallel.mesh import init_distributed, make_mesh
+
+nproc = init_distributed()  # reads JAX_COORDINATOR_ADDRESS / _ID / _NUM
+assert nproc == 2, nproc
+assert jax.process_count() == 2
+devs = jax.devices()
+local = jax.local_device_count()
+assert len(devs) == 2 * local, (len(devs), local)
+
+# dp mesh over EVERY device of BOTH processes; the psum the loss below
+# induces is a cross-process all-reduce.
+mesh = make_mesh(dp=len(devs), tp=1)
+sharding = NamedSharding(mesh, P("dp"))
+n = len(devs)
+
+# Each process materializes its local shards; value = global position.
+x = jax.make_array_from_callback(
+    (n,), sharding, lambda idx: np.arange(n, dtype=np.float32)[idx]
+)
+total = jax.jit(
+    lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P())
+)(x)
+expect = n * (n - 1) / 2
+assert float(total) == expect, (float(total), expect)
+print(f"proc {jax.process_index()}: global sum over {n} devices ok",
+      flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_dcn_smoke():
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = {
+            k: v for k, v in os.environ.items()
+            if k != "PALLAS_AXON_POOL_IPS"  # no TPU plugin in children
+        }
+        flags = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip(),
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(pid),
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", CHILD], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert "global sum over 4 devices ok" in out
