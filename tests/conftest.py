@@ -95,3 +95,40 @@ def clean_state():
     yield
     clear_globals()
     get_perf_stats().reset()
+
+
+# -- fast/slow lanes ---------------------------------------------------------
+# VERDICT r03 #6: the full suite cannot finish inside a 10-minute window
+# single-process on a 1-core box. Tests measured >= ~8 s there (compile-
+# heavy multi-device oracles, subprocess re-execs, in-tree training runs)
+# carry the `slow` marker, so `-m "not slow"` is a fast smoke lane and
+# CI can split lanes. Central list (nodeid substrings) rather than
+# per-file decorators so the lane is auditable in one place; tests may
+# also self-mark with @pytest.mark.slow (e.g. test_distributed).
+SLOW_TESTS = (
+    "test_training.py::test_graft_dryrun_multichip_8",
+    "test_bench_harness.py::test_wedged_child_killed_and_fallback_lands",
+    "test_bench_harness.py::test_tiny_budget_goes_straight_to_fallback",
+    "test_bench_harness.py::test_orchestrated_cpu_ends_with_headline_json",
+    "test_trained_agent.py::test_train_serve_agent_roundtrip",
+    "test_pipeline.py::test_pp2_",
+    "test_pipeline.py::test_pp_remat_matches",
+    "test_real_checkpoint.py::test_agent_loop_from_saved_checkpoint",
+    "test_train_checkpoint.py::test_save_restore_roundtrip",
+    "test_engine.py::test_long_generation_crosses_pages",
+    "test_engine.py::test_generate_matches_oracle",
+    "test_engine.py::test_warmup_compiles_without_disturbing_state",
+    "test_serving_api.py::test_tpu_scheme_lazy_registration_fresh_process",
+    "test_constrained.py::TestEngineWiring::test_response_format_constrains",
+    "test_speculative.py::test_speculative_matches_vanilla_greedy",
+    "test_moe.py::test_sharded_moe_training_step",
+    "test_ring_attention.py::test_ring_gradients_flow",
+    "test_tool_choice.py::test_required_constrains_to_listed_tools",
+    "test_quant.py::test_quantized_forward_close_to_fp",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if any(s in item.nodeid for s in SLOW_TESTS):
+            item.add_marker(pytest.mark.slow)

@@ -71,9 +71,16 @@ def test_long_generation_crosses_pages(engine):
 
 
 def test_pages_freed_after_finish(engine):
-    free_before = engine.alloc.free_pages
-    engine.generate([[257, 1, 2, 3, 4, 5]], SamplingParams(max_tokens=5))
-    assert engine.alloc.free_pages == free_before
+    # First pass may DONATE full pages to the prefix trie (finish()
+    # retains them as evictable cache, not leaked) — so the conservation
+    # check runs on the steady state: an identical second generate must
+    # return the allocator to exactly the first pass's level, and the
+    # donated prefix must be re-borrowed, not re-allocated.
+    prompt = [257, 1, 2, 3, 4, 5]
+    engine.generate([prompt], SamplingParams(max_tokens=5))
+    free_after_first = engine.alloc.free_pages
+    engine.generate([prompt], SamplingParams(max_tokens=5))
+    assert engine.alloc.free_pages == free_after_first
     assert engine.sequences == {}
 
 
