@@ -470,6 +470,123 @@ def get_config_preset(name: str) -> ModelConfig:
     raise KeyError(f"unknown model preset '{name}' (have: {sorted(PRESETS)})")
 
 
+def config_from_hf(path: str, name: str = "") -> ModelConfig:
+    """Derive a ModelConfig from an HF checkpoint dir's ``config.json``
+    (model_type ``llama`` / ``qwen2``), so ANY HF llama-family checkpoint
+    directory is servable without a hand-written preset. The reference
+    needs no model configs at all — its "model" is a remote API
+    (reference pkg/llms/openai.go:69); here the checkpoint's own metadata
+    is the source of truth. ``path`` may be the dir or the json file."""
+    import json
+    import os
+
+    cfg_path = (
+        os.path.join(path, "config.json") if os.path.isdir(path) else path
+    )
+    with open(cfg_path, encoding="utf-8") as f:
+        hf = json.load(f)
+    mt = hf.get("model_type", "llama")
+    if mt not in ("llama", "qwen2"):
+        raise ValueError(
+            f"config_from_hf supports model_type llama/qwen2, got {mt!r} "
+            f"(MoE/MLA families need an explicit preset)"
+        )
+    rs = None
+    hf_rs = hf.get("rope_scaling") or None
+    if hf_rs:
+        rt = hf_rs.get("rope_type") or hf_rs.get("type")
+        if rt == "llama3":
+            rs = RopeScalingConfig(
+                rope_type="llama3",
+                factor=float(hf_rs["factor"]),
+                original_max_position=int(
+                    hf_rs["original_max_position_embeddings"]
+                ),
+                low_freq_factor=float(hf_rs.get("low_freq_factor", 1.0)),
+                high_freq_factor=float(hf_rs.get("high_freq_factor", 4.0)),
+            )
+        elif rt == "yarn":
+            rs = RopeScalingConfig(
+                rope_type="yarn",
+                factor=float(hf_rs["factor"]),
+                original_max_position=int(
+                    hf_rs["original_max_position_embeddings"]
+                ),
+                beta_fast=float(hf_rs.get("beta_fast", 32.0)),
+                beta_slow=float(hf_rs.get("beta_slow", 1.0)),
+                mscale=float(hf_rs.get("mscale", 1.0)),
+                mscale_all_dim=float(hf_rs.get("mscale_all_dim", 0.0)),
+            )
+        else:
+            raise ValueError(f"unsupported rope_scaling type {rt!r}")
+    heads = int(hf["num_attention_heads"])
+    return ModelConfig(
+        name=name or os.path.basename(os.path.normpath(
+            path if os.path.isdir(path) else os.path.dirname(cfg_path)
+        )) or mt,
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=int(hf["hidden_size"]),
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=int(hf["num_hidden_layers"]),
+        num_heads=heads,
+        num_kv_heads=int(hf.get("num_key_value_heads", heads)),
+        head_dim=int(hf.get("head_dim") or 0),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        # Qwen2 checkpoints carry q/k/v biases without an explicit flag.
+        attn_bias=(mt == "qwen2") or bool(hf.get("attention_bias", False)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        max_position=int(hf.get("max_position_embeddings", 8192)),
+        rope_scaling=rs,
+    )
+
+
+def hf_config_dict(cfg: ModelConfig) -> dict:
+    """``config.json`` contents for a dense ModelConfig — the inverse of
+    ``config_from_hf`` (checkpoint export; MoE/MLA export unsupported)."""
+    if cfg.moe or cfg.mla:
+        raise ValueError("hf_config_dict supports dense llama/qwen2 models")
+    hf: dict = {
+        "model_type": "qwen2" if cfg.attn_bias else "llama",
+        "architectures": [
+            "Qwen2ForCausalLM" if cfg.attn_bias else "LlamaForCausalLM"
+        ],
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "max_position_embeddings": cfg.max_position,
+    }
+    if cfg.head_dim:
+        hf["head_dim"] = cfg.head_dim
+    if cfg.rope_scaling:
+        rs = cfg.rope_scaling
+        if rs.rope_type == "llama3":
+            hf["rope_scaling"] = {
+                "rope_type": "llama3",
+                "factor": rs.factor,
+                "original_max_position_embeddings": rs.original_max_position,
+                "low_freq_factor": rs.low_freq_factor,
+                "high_freq_factor": rs.high_freq_factor,
+            }
+        else:
+            hf["rope_scaling"] = {
+                "rope_type": rs.rope_type,
+                "factor": rs.factor,
+                "original_max_position_embeddings": rs.original_max_position,
+                "beta_fast": rs.beta_fast,
+                "beta_slow": rs.beta_slow,
+                "mscale": rs.mscale,
+                "mscale_all_dim": rs.mscale_all_dim,
+            }
+    return hf
+
+
 def scaled_for_test(cfg: ModelConfig, vocab_size: int = 512) -> ModelConfig:
     """Shrink a preset's vocab for fast CPU tests, keeping its shape ratios."""
     return replace(cfg, vocab_size=vocab_size)

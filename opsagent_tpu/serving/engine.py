@@ -124,8 +124,11 @@ class EngineConfig:
     # verify them in one multi-position forward — 1..k+1 tokens per
     # weight-streaming pass. Exact for greedy sampling (the agent-loop
     # default); non-greedy batches fall back to the vanilla pipeline.
-    # Agent ReAct loops re-emit the same JSON scaffolding every iteration,
-    # so lookup drafts hit constantly. 0 disables.
+    # Default 0 BY MEASUREMENT (PERF.md r04): on the trained-agent ReAct
+    # workload lookup drafts hit only ~6.6 % — replies share JSON keys
+    # with the prompt but the values are novel — so dispatches per token
+    # rise and speculation is a net loss there. Enable for workloads with
+    # genuinely repetitive continuations (templated YAML etc.).
     speculative_k: int = 0
     speculative_ngram: int = 2
     # Max admitting sequences prefilled per batched dispatch (scheduler
@@ -236,8 +239,14 @@ class Engine:
         tp = cfg.tp if cfg.tp > 0 else max(
             1, n_dev // slots if n_dev % slots == 0 else 1
         )
-        # kv heads must divide cleanly over tp; fall back gracefully.
-        while tp > 1 and self.model_cfg.num_kv_heads % tp != 0:
+        # kv heads AND the vocab (embedding/lm-head shard dim) must divide
+        # cleanly over tp; fall back gracefully. Vocab matters for
+        # HF-derived configs (config_from_hf): a tokenizer-sized odd
+        # vocab with auto-tp would otherwise fail at shard_params.
+        while tp > 1 and (
+            self.model_cfg.num_kv_heads % tp != 0
+            or self.model_cfg.vocab_size % tp != 0
+        ):
             tp -= 1
         self.mesh = make_mesh(tp=tp, dp=cfg.dp, sp=cfg.sp, ep=cfg.ep)
         self.lock = threading.RLock()
@@ -1192,8 +1201,19 @@ class Engine:
                     # Speculative block: toks is [B, n_steps, k+1] with an
                     # explicit accepted count per scan step (pads within a
                     # step are rejection holes, not end-of-output).
+                    # Accept-rate observability: each LIVE verify step
+                    # (count > 0) emitted 1 corrected/bonus token plus its
+                    # accepted drafts, so mean(spec_step_tokens) - 1 over k
+                    # IS the draft accept rate on this workload. Recorded
+                    # per step BEFORE the done-break so post-EOS steps
+                    # (drafting from dead context) cannot drag the mean.
                     for st in range(counts.shape[1]):
-                        for j in range(int(counts[lane, st])):
+                        c = int(counts[lane, st])
+                        if c > 0 and not s.done:
+                            perf.record_metric(
+                                "engine.spec_step_tokens", float(c), "tok"
+                            )
+                        for j in range(c):
                             self._accept_token(s, int(toks[lane, st, j]))
                             if s.done:
                                 break

@@ -43,10 +43,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--checkpoint", default=os.environ.get("OPSAGENT_CHECKPOINT", ""))
-    ap.add_argument("--model-name", default=os.environ.get("OPSAGENT_MODEL_NAME", "llama-3-8b-instruct"))
+    ap.add_argument("--model-name",
+                    default=os.environ.get("OPSAGENT_MODEL_NAME", "auto"),
+                    help="preset name, or 'auto' to derive the architecture "
+                         "from the checkpoint dir's config.json "
+                         "(models.config.config_from_hf)")
     ap.add_argument("--tokenizer", default="", help="defaults to the checkpoint dir")
     ap.add_argument("--quantize", default="", choices=("", "int8"))
     ap.add_argument("--tp", type=int, default=0)
+    ap.add_argument("--num-pages", type=int, default=0,
+                    help="KV page pool size (0 = engine default); raise "
+                         "for long prompts / verbose tokenizers")
+    ap.add_argument("--max-pages-per-seq", type=int, default=0,
+                    help="per-sequence page cap (0 = engine default)")
     ap.add_argument("--instruction", default="count namespaces")
     ap.add_argument("--max-iterations", type=int, default=5)
     ap.add_argument("--transcript", default="")
@@ -61,15 +70,35 @@ def main() -> int:
     from opsagent_tpu.serving.api import ServingStack, install_stack
     from opsagent_tpu.serving.engine import Engine, EngineConfig
 
+    model_cfg = None
+    model_name = args.model_name
+    if model_name == "auto":
+        from opsagent_tpu.models.config import PRESETS, config_from_hf
+
+        model_cfg = config_from_hf(args.checkpoint)
+        model_name = model_cfg.name
+        print(f"config.json -> {model_name}: {model_cfg.num_layers}L "
+              f"d={model_cfg.hidden_size} heads={model_cfg.num_heads}/"
+              f"{model_cfg.num_kv_heads} vocab={model_cfg.vocab_size}",
+              file=sys.stderr)
+        if model_name in PRESETS:
+            model_cfg = None  # let the preset (engine default) win
+
     t0 = time.perf_counter()
+    overrides = {}
+    if args.num_pages:
+        overrides["num_pages"] = args.num_pages
+    if args.max_pages_per_seq:
+        overrides["max_pages_per_seq"] = args.max_pages_per_seq
     engine = Engine(EngineConfig(
-        model=args.model_name,
+        model=model_name,
         checkpoint=args.checkpoint,
         tokenizer=args.tokenizer or args.checkpoint,
         quantize=args.quantize,
         tp=args.tp,
         dtype=jnp.bfloat16,
-    ))
+        **overrides,
+    ), model_cfg=model_cfg)
     print(f"engine up (weights loaded+sharded) in {time.perf_counter()-t0:.1f}s",
           file=sys.stderr)
     stack = ServingStack(engine)

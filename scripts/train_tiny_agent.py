@@ -98,18 +98,21 @@ def build_convs():
     ]
 
 
-def train_bpe_tokenizer(out_dir: str) -> str:
+def train_bpe_tokenizer(out_dir: str, extra_corpus: tuple[str, ...] = (),
+                        vocab_size: int = 512) -> str:
     """Train a REAL byte-level-BPE tokenizer (HF fast-tokenizer format)
     on the agent corpus and save it loadable via AutoTokenizer — the demo
     then exercises the same HFTokenizer path real checkpoints use, not
-    the byte fallback. Returns the tokenizer dir."""
+    the byte fallback. ``extra_corpus`` adds more training text (e.g. the
+    full ReAct system prompt, so long prompts compress instead of
+    exploding to near-byte token counts). Returns the tokenizer dir."""
     import json as jsonlib
 
     from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
 
     from opsagent_tpu.serving.chat_template import render_llama3
 
-    corpus = []
+    corpus = list(extra_corpus)
     for messages, reply in build_convs():
         corpus.append(render_llama3(messages))
         corpus.append(reply)
@@ -117,7 +120,7 @@ def train_bpe_tokenizer(out_dir: str) -> str:
     tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
     tok.decoder = decoders.ByteLevel()
     trainer = trainers.BpeTrainer(
-        vocab_size=512, special_tokens=["<bos>", "<eos>", "<pad>"],
+        vocab_size=vocab_size, special_tokens=["<bos>", "<eos>", "<pad>"],
         show_progress=False,
         # Full byte alphabet: without it, bytes absent from the tiny
         # corpus would be silently DROPPED at encode time (unk is None),
