@@ -8,12 +8,13 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to four presets in isolated
+A plain `python bench.py` orchestrates up to six stages in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
 the guaranteed number), then the bench-8b int8 headline, then the
 BASELINE config-5 concurrent-sessions run, then a speculative-decoding
-overhead run. EVERY result line is printed
+overhead run, a pallas-dma kernel comparison, and a cold-restart TTFT
+probe against the stage-1-primed compilation cache. EVERY result line is printed
 and flushed the moment it exists (the driver kills this process at an
 unknown wall clock; an already-earned number must survive), and a
 combined headline line is printed last. If the default preset dies —
@@ -148,10 +149,11 @@ def run_orchestrated() -> None:
     the driver's last-JSON-line parse picks it up.
 
     Order: default preset (bench-1b on TPU, tiny-test elsewhere — the
-    guaranteed number), then the bench-8b int8 headline, then the
-    BASELINE config-5 concurrent-sessions run, then a speculative-
-    decoding overhead run; stages 2-4 only start if the remaining budget
-    plausibly covers them. Mode/spec env vars are stripped from stages
+    guaranteed number), then the bench-8b int8 headline, the BASELINE
+    config-5 concurrent-sessions run, a speculative-decoding overhead
+    run, the pallas-dma kernel comparison, and the cold-restart TTFT
+    probe; stages 2-6 only start if the remaining budget plausibly
+    covers them. Mode/spec env vars are stripped from stages
     they don't belong to, so an operator-set OPSAGENT_BENCH_SPEC cannot
     contaminate the baseline stages."""
     budget = float(os.environ.get("OPSAGENT_BENCH_BUDGET", "850"))
@@ -246,6 +248,16 @@ def run_orchestrated() -> None:
          "OPSAGENT_PAGED_BACKEND": "pallas-dma"},
         150, "pallas-dma",
     ) if on_tpu else None
+    # Cold-restart TTFT proof (VERDICT r03 #9): stage 1 primed the
+    # persistent compilation cache; this fresh process re-inits the same
+    # preset, so its init_s/warmup_s/first_ttft_ms ARE the
+    # cold-process-warm-cache restart numbers against the p50 < 500 ms
+    # target. Short decode: only the startup path matters here.
+    rcold = stage(
+        {"OPSAGENT_BENCH_MODEL": "bench-1b",
+         "OPSAGENT_BENCH_STEPS": "64"},
+        120, "cold-restart",
+    ) if on_tpu else None
 
     if headline is None:
         log("bench: no preset produced a number")
@@ -263,6 +275,11 @@ def run_orchestrated() -> None:
         extra[f"spec{SPEC_K}_overhead_tok_s_chip"] = rspec["value"]
     if rdma is not None:
         extra["pallas_dma_tok_s_chip"] = rdma["value"]
+    if rcold is not None:
+        ce = rcold.get("extra", {})
+        extra["cold_restart_first_ttft_ms"] = ce.get("first_ttft_ms")
+        extra["cold_restart_init_s"] = ce.get("init_s")
+        extra["cold_restart_warmup_s"] = ce.get("warmup_s")
     out = dict(headline, extra=extra)
     print(json.dumps(out), flush=True)
 
