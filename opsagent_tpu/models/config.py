@@ -472,11 +472,13 @@ def get_config_preset(name: str) -> ModelConfig:
 
 def config_from_hf(path: str, name: str = "") -> ModelConfig:
     """Derive a ModelConfig from an HF checkpoint dir's ``config.json``
-    (model_type ``llama`` / ``qwen2``), so ANY HF llama-family checkpoint
-    directory is servable without a hand-written preset. The reference
-    needs no model configs at all — its "model" is a remote API
-    (reference pkg/llms/openai.go:69); here the checkpoint's own metadata
-    is the source of truth. ``path`` may be the dir or the json file."""
+    (model_type ``llama`` / ``qwen2`` / ``deepseek`` / ``deepseek_v2`` /
+    ``deepseek_v3`` — the dense, MoE, and MLA families this engine
+    serves), so ANY such HF checkpoint directory is servable without a
+    hand-written preset. The reference needs no model configs at all —
+    its "model" is a remote API (reference pkg/llms/openai.go:69); here
+    the checkpoint's own metadata is the source of truth. ``path`` may
+    be the dir or the json file."""
     import json
     import os
 
@@ -486,11 +488,56 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
     with open(cfg_path, encoding="utf-8") as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "qwen2"):
+    if mt not in ("llama", "qwen2", "deepseek", "deepseek_v2", "deepseek_v3"):
         raise ValueError(
-            f"config_from_hf supports model_type llama/qwen2, got {mt!r} "
-            f"(MoE/MLA families need an explicit preset)"
+            f"config_from_hf supports model_type llama/qwen2/deepseek/"
+            f"deepseek_v2/deepseek_v3, got {mt!r}"
         )
+    moe = None
+    mla = None
+    moe_layer_start = 0
+    if mt.startswith("deepseek"):
+        if int(hf.get("moe_layer_freq", 1)) != 1:
+            raise ValueError(
+                "only moe_layer_freq=1 (contiguous MoE stack after the "
+                "dense prefix) is supported"
+            )
+        if hf.get("n_routed_experts"):
+            scoring = hf.get("scoring_func", "softmax")
+            if scoring not in ("softmax", "sigmoid"):
+                # Reject rather than silently routing with softmax
+                # semantics (models.llama falls back to softmax for
+                # unknown scoring functions).
+                raise ValueError(
+                    f"unsupported router scoring_func {scoring!r}"
+                )
+            moe = MoEConfig(
+                num_experts=int(hf["n_routed_experts"]),
+                num_experts_per_token=int(hf["num_experts_per_tok"]),
+                num_shared_experts=int(hf.get("n_shared_experts", 0) or 0),
+                expert_intermediate_size=int(
+                    hf.get("moe_intermediate_size", 0) or 0
+                ),
+                norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+                routed_scaling_factor=float(
+                    hf.get("routed_scaling_factor", 1.0)
+                ),
+                scoring_func=scoring,
+                n_group=int(hf.get("n_group", 1) or 1),
+                topk_group=int(hf.get("topk_group", 1) or 1),
+            )
+            moe_layer_start = int(hf.get("first_k_dense_replace", 0))
+        if mt in ("deepseek_v2", "deepseek_v3"):
+            mla = MLAConfig(
+                q_lora_rank=int(hf.get("q_lora_rank") or 0),
+                kv_lora_rank=int(hf["kv_lora_rank"]),
+                qk_nope_head_dim=int(hf["qk_nope_head_dim"]),
+                qk_rope_head_dim=int(hf["qk_rope_head_dim"]),
+                v_head_dim=int(hf["v_head_dim"]),
+                # Serve V2/V3 with the compressed latent pages — the
+                # whole point of MLA (engine latent-cache path).
+                latent_cache=True,
+            )
     rs = None
     hf_rs = hf.get("rope_scaling") or None
     if hf_rs:
@@ -529,14 +576,20 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
         intermediate_size=int(hf["intermediate_size"]),
         num_layers=int(hf["num_hidden_layers"]),
         num_heads=heads,
-        num_kv_heads=int(hf.get("num_key_value_heads", heads)),
-        head_dim=int(hf.get("head_dim") or 0),
+        # MLA has no GQA: the latent is the compression.
+        num_kv_heads=heads if mla else int(
+            hf.get("num_key_value_heads", heads)
+        ),
+        head_dim=mla.qk_head_dim if mla else int(hf.get("head_dim") or 0),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         # Qwen2 checkpoints carry q/k/v biases without an explicit flag.
         attn_bias=(mt == "qwen2") or bool(hf.get("attention_bias", False)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
         max_position=int(hf.get("max_position_embeddings", 8192)),
+        moe=moe,
+        moe_layer_start=moe_layer_start,
+        mla=mla,
         rope_scaling=rs,
     )
 

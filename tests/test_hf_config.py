@@ -88,9 +88,86 @@ def test_hf_config_rejects_unknown_family(tmp_path):
     from opsagent_tpu.models.config import config_from_hf
 
     with open(tmp_path / "config.json", "w") as f:
-        json.dump({"model_type": "deepseek_v3"}, f)
-    with pytest.raises(ValueError, match="deepseek_v3"):
+        json.dump({"model_type": "mixtral"}, f)
+    with pytest.raises(ValueError, match="mixtral"):
         config_from_hf(str(tmp_path))
+
+
+def test_hf_config_deepseek_v2_matches_preset(tmp_path):
+    """A V2-Lite-shaped config.json derives the SAME ModelConfig the
+    hand-written preset carries (which mirrors the HF fields 1:1) — MLA,
+    MoE, and YaRN scaling included."""
+    from opsagent_tpu.models.config import config_from_hf, get_config_preset
+
+    hf = {
+        "model_type": "deepseek_v2",
+        "vocab_size": 102400,
+        "hidden_size": 2048,
+        "intermediate_size": 10944,
+        "moe_intermediate_size": 1408,
+        "num_hidden_layers": 27,
+        "num_attention_heads": 16,
+        "num_key_value_heads": 16,
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-6,
+        "max_position_embeddings": 163840,
+        "n_routed_experts": 64,
+        "num_experts_per_tok": 6,
+        "n_shared_experts": 2,
+        "first_k_dense_replace": 1,
+        "moe_layer_freq": 1,
+        "norm_topk_prob": False,
+        "scoring_func": "softmax",
+        "q_lora_rank": None,
+        "kv_lora_rank": 512,
+        "qk_nope_head_dim": 128,
+        "qk_rope_head_dim": 64,
+        "v_head_dim": 128,
+        "rope_scaling": {
+            "type": "yarn", "factor": 40.0,
+            "original_max_position_embeddings": 4096,
+            "beta_fast": 32, "beta_slow": 1,
+            "mscale": 0.707, "mscale_all_dim": 0.707,
+        },
+    }
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf, f)
+    cfg = config_from_hf(str(tmp_path))
+    want = get_config_preset("deepseek-v2-lite")
+    for fld in ("vocab_size", "hidden_size", "intermediate_size",
+                "num_layers", "num_heads", "num_kv_heads", "head_dim_",
+                "rope_theta", "rms_norm_eps", "max_position",
+                "moe_layer_start", "moe", "mla", "rope_scaling"):
+        assert getattr(cfg, fld) == getattr(want, fld), fld
+
+
+def test_hf_config_deepseek_v3_router_fields(tmp_path):
+    from opsagent_tpu.models.config import config_from_hf
+
+    hf = {
+        "model_type": "deepseek_v3",
+        "vocab_size": 129280, "hidden_size": 7168,
+        "intermediate_size": 18432, "moe_intermediate_size": 2048,
+        "num_hidden_layers": 61, "num_attention_heads": 128,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 163840,
+        "n_routed_experts": 256, "num_experts_per_tok": 8,
+        "n_shared_experts": 1, "first_k_dense_replace": 3,
+        "norm_topk_prob": True, "routed_scaling_factor": 2.5,
+        "scoring_func": "sigmoid", "n_group": 8, "topk_group": 4,
+        "q_lora_rank": 1536, "kv_lora_rank": 512,
+        "qk_nope_head_dim": 128, "qk_rope_head_dim": 64,
+        "v_head_dim": 128,
+    }
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf, f)
+    cfg = config_from_hf(str(tmp_path))
+    assert cfg.moe.scoring_func == "sigmoid"
+    assert cfg.moe.norm_topk_prob and cfg.moe.routed_scaling_factor == 2.5
+    assert (cfg.moe.n_group, cfg.moe.topk_group) == (8, 4)
+    assert cfg.mla.q_lora_rank == 1536 and cfg.mla.latent_cache
+    assert cfg.num_kv_heads == 128  # MLA: no GQA
+    assert cfg.moe_layer_start == 3
+    assert cfg.head_dim_ == 192
 
 
 @pytest.mark.slow
@@ -171,3 +248,20 @@ def test_run_real_checkpoint_script_auto_config(tmp_path):
     assert json.loads(last)["ok"] is True
     assert "config.json -> tiny-hf-release" in out.stderr
     assert (tmp_path / "transcript.md").exists()
+
+
+def test_hf_config_rejects_unknown_scoring_func(tmp_path):
+    from opsagent_tpu.models.config import config_from_hf
+
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump({
+            "model_type": "deepseek_v3", "vocab_size": 100,
+            "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "n_routed_experts": 8, "num_experts_per_tok": 2,
+            "kv_lora_rank": 16, "qk_nope_head_dim": 8,
+            "qk_rope_head_dim": 8, "v_head_dim": 8,
+            "scoring_func": "mystery",
+        }, f)
+    with pytest.raises(ValueError, match="mystery"):
+        config_from_hf(str(tmp_path))
