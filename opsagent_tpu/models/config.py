@@ -594,6 +594,27 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
     )
 
 
+def resolve_model(
+    model_name: str, checkpoint: str = ""
+) -> tuple[str, Optional[ModelConfig]]:
+    """Resolve a --model-name flag to ``(name, model_cfg_or_None)``.
+
+    A preset name passes through with ``None`` (the engine resolves the
+    preset itself); ``auto`` derives the architecture from the checkpoint
+    dir's ``config.json`` via ``config_from_hf``. In auto mode the
+    checkpoint's own metadata is AUTHORITATIVE — even when the dir's
+    basename collides with a preset name (a renamed snapshot or a
+    fine-tune with different dims must serve with ITS config, not the
+    preset's). One shared policy for serve-engine and
+    scripts/run_real_checkpoint.py."""
+    if model_name != "auto":
+        return model_name, None
+    if not checkpoint:
+        raise ValueError("--model-name auto requires --checkpoint")
+    cfg = config_from_hf(checkpoint)
+    return cfg.name, cfg
+
+
 def hf_config_dict(cfg: ModelConfig) -> dict:
     """``config.json`` contents for a dense ModelConfig — the inverse of
     ``config_from_hf`` (checkpoint export; MoE/MLA export unsupported)."""

@@ -41,7 +41,14 @@ class ServingStack:
         # from the same config if the device runtime fails persistently,
         # re-admitting in-flight work (scheduler._recover). ``engine``
         # is a property so restarts are transparent to every consumer.
-        factory = (lambda cfg=engine.cfg: Engine(cfg)) if restart_tolerant else None
+        # The resolved model_cfg rides along: an auto-derived (non-preset)
+        # architecture must survive the rebuild, or recovery would die in
+        # get_config_preset on the checkpoint-dir name.
+        factory = (
+            lambda cfg=engine.cfg, mc=engine.model_cfg: Engine(
+                cfg, model_cfg=mc
+            )
+        ) if restart_tolerant else None
         self.scheduler = Scheduler(engine, engine_factory=factory)
         self.scheduler.start()
         self.model_name = engine.model_cfg.name
@@ -727,6 +734,17 @@ def run_engine_server(
 ) -> None:
     from aiohttp import web
 
+    from ..models.config import resolve_model
+
+    model_name, model_cfg = resolve_model(model_name, checkpoint)
+    if model_cfg is not None:
+        log.info(
+            "config.json -> %s: %dL d=%d heads=%d/%d vocab=%d",
+            model_name, model_cfg.num_layers, model_cfg.hidden_size,
+            model_cfg.num_heads, model_cfg.num_kv_heads,
+            model_cfg.vocab_size,
+        )
+
     cfg = EngineConfig(
         model=model_name,
         checkpoint=checkpoint,
@@ -741,7 +759,7 @@ def run_engine_server(
         # so no client ever pays XLA compile inside its TTFT.
         warmup=True,
     )
-    engine = Engine(cfg)
+    engine = Engine(cfg, model_cfg=model_cfg)
     stack = ServingStack(engine)
     install_stack(model_name, stack)
     app = build_engine_app(stack)

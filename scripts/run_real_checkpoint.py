@@ -70,19 +70,14 @@ def main() -> int:
     from opsagent_tpu.serving.api import ServingStack, install_stack
     from opsagent_tpu.serving.engine import Engine, EngineConfig
 
-    model_cfg = None
-    model_name = args.model_name
-    if model_name == "auto":
-        from opsagent_tpu.models.config import PRESETS, config_from_hf
+    from opsagent_tpu.models.config import resolve_model
 
-        model_cfg = config_from_hf(args.checkpoint)
-        model_name = model_cfg.name
+    model_name, model_cfg = resolve_model(args.model_name, args.checkpoint)
+    if model_cfg is not None:
         print(f"config.json -> {model_name}: {model_cfg.num_layers}L "
               f"d={model_cfg.hidden_size} heads={model_cfg.num_heads}/"
               f"{model_cfg.num_kv_heads} vocab={model_cfg.vocab_size}",
               file=sys.stderr)
-        if model_name in PRESETS:
-            model_cfg = None  # let the preset (engine default) win
 
     t0 = time.perf_counter()
     overrides = {}

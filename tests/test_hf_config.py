@@ -265,3 +265,64 @@ def test_hf_config_rejects_unknown_scoring_func(tmp_path):
         }, f)
     with pytest.raises(ValueError, match="mystery"):
         config_from_hf(str(tmp_path))
+
+
+def test_resolve_model_policy(tmp_path):
+    """One shared resolution policy (serve-engine + run_real_checkpoint):
+    presets pass through; auto derives from config.json and the
+    checkpoint's own metadata is authoritative even when the dir's
+    basename collides with a preset name."""
+    from opsagent_tpu.models.config import (
+        get_config_preset,
+        hf_config_dict,
+        resolve_model,
+    )
+
+    assert resolve_model("tiny-test") == ("tiny-test", None)
+    with pytest.raises(ValueError, match="requires --checkpoint"):
+        resolve_model("auto")
+
+    # A dir NAMED like a preset but carrying different dims: the derived
+    # config must win (a renamed snapshot / fine-tune with other dims).
+    ckpt = tmp_path / "tiny-test"
+    ckpt.mkdir()
+    cfg = dataclasses.replace(
+        get_config_preset("tiny-test"), vocab_size=777, hidden_size=96,
+        intermediate_size=192, num_heads=6, num_kv_heads=3, head_dim=0,
+    )
+    with open(ckpt / "config.json", "w") as f:
+        json.dump(hf_config_dict(cfg), f)
+    name, derived = resolve_model("auto", str(ckpt))
+    assert name == "tiny-test"
+    assert derived is not None and derived.vocab_size == 777
+    assert derived.hidden_size == 96
+
+
+def test_restart_factory_keeps_auto_model_cfg():
+    """ADVICE-style regression: the slice-restart factory must carry the
+    resolved model_cfg — an auto-derived (non-preset) architecture has no
+    preset to fall back to, so a recovery rebuild without it would die in
+    get_config_preset on the checkpoint-dir name."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from opsagent_tpu.models.config import get_config_preset
+    from opsagent_tpu.serving.api import ServingStack
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    cfg = dc.replace(get_config_preset("tiny-test"), name="no-such-preset")
+    eng = Engine(
+        EngineConfig(
+            model="no-such-preset", dtype=jnp.float32, tp=1,
+            num_pages=16, page_size=8, max_pages_per_seq=4,
+            max_batch_size=2, prefill_buckets=(16,),
+        ),
+        model_cfg=cfg,
+    )
+    stack = ServingStack(eng)
+    try:
+        rebuilt = stack.scheduler._engine_factory()
+        assert rebuilt.model_cfg.name == "no-such-preset"
+    finally:
+        stack.close()
