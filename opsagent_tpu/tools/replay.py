@@ -33,6 +33,28 @@ case "$args" in
 esac
 """
 
+# Fixed mini-cluster for the MULTI-instruction trained-agent demo
+# (scripts/train_tiny_agent.py --tasks multi): namespaces, pods (all/-n
+# default), nodes, and version — every command the task corpus trains
+# on answers byte-exactly here, so served observations match training.
+MULTI_TASK_SCRIPT = """#!/bin/bash
+args="$*"
+case "$args" in
+  *"get namespaces"*)
+    printf 'default\\nkube-system\\nmonitoring\\n' ;;
+  *"get nodes"*)
+    printf 'node-a   Ready\\nnode-b   Ready\\nnode-c   NotReady\\n' ;;
+  *version*)
+    printf 'Server Version: v1.29.3\\n' ;;
+  *"get pods -n default"*)
+    printf 'web-1   Running\\napi-1   Running\\n' ;;
+  *"get pods"*)
+    printf 'web-1   Running\\nweb-2   CrashLoopBackOff\\n' ;;
+  *)
+    printf 'replay: no canned output for: %s\\n' "$args" >&2; exit 1 ;;
+esac
+"""
+
 
 def install_replay_kubectl(
     script: str = NAMESPACES_SCRIPT, tooldir: str | None = None

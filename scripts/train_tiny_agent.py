@@ -59,47 +59,119 @@ INSTRUCTION = "count namespaces"
 KUBECTL_CMD = "kubectl get namespaces --no-headers | wc -l"
 FINAL_ANSWER = "There are 3 namespaces in the cluster."
 
+# Each task: one two-turn ReAct episode (tool call -> observation ->
+# final answer). ``observation`` must match BYTE-EXACTLY what the replay
+# tool emits at serve time (tools/replay.py MULTI_TASK_SCRIPT), or the
+# served turn-2 prompt diverges from the trained one.
+TASKS_SINGLE = [dict(
+    instruction=INSTRUCTION,
+    tool="kubectl", tool_input=KUBECTL_CMD, observation="3",
+    thought1="I will count namespaces with kubectl.",
+    thought2="The observation shows 3 namespaces.",
+    obs2="The cluster has 3 namespaces.",
+    final=FINAL_ANSWER,
+)]
 
-def build_convs():
-    """The two agent turns, serialized with the live loop's own wire code
-    (tools.ToolPrompt) — (messages, target reply) pairs."""
+TASKS_MULTI = TASKS_SINGLE + [
+    dict(
+        instruction="which pods are crashing",
+        tool="kubectl",
+        tool_input="kubectl get pods -A | grep CrashLoopBackOff",
+        observation="web-2   CrashLoopBackOff",
+        thought1="I will grep pod listings for crash loops.",
+        thought2="One pod is in CrashLoopBackOff.",
+        obs2="web-2 is crash-looping.",
+        final="Pod web-2 is in CrashLoopBackOff.",
+    ),
+    dict(
+        instruction="how many nodes are ready",
+        tool="kubectl",
+        tool_input="kubectl get nodes --no-headers | grep -cw Ready",
+        observation="2",
+        thought1="I will count Ready nodes with kubectl.",
+        thought2="Two nodes report Ready.",
+        obs2="2 nodes are Ready.",
+        final="2 of the 3 nodes are Ready.",
+    ),
+    dict(
+        instruction="what kubernetes version is the cluster running",
+        tool="kubectl",
+        tool_input="kubectl version --short",
+        observation="Server Version: v1.29.3",
+        thought1="I will ask kubectl for the server version.",
+        thought2="The server reports its version.",
+        obs2="Server version v1.29.3.",
+        final="The cluster runs Kubernetes v1.29.3.",
+    ),
+    dict(
+        instruction="how many pods run in the default namespace",
+        tool="kubectl",
+        tool_input="kubectl get pods -n default --no-headers | wc -l",
+        observation="2",
+        thought1="I will count pods in default with kubectl.",
+        thought2="There are two pods in default.",
+        obs2="2 pods in default.",
+        final="There are 2 pods in the default namespace.",
+    ),
+    dict(
+        instruction="compute 6*7 using python",
+        tool="python",
+        tool_input="print(6*7)",
+        observation="42",
+        thought1="I will run the expression with the python tool.",
+        thought2="The script printed 42.",
+        obs2="The result is 42.",
+        # >= 10 chars: the loop's template heuristic (react.py
+        # is_template_value, reference simple.go:624-657) rejects
+        # implausibly short finals like "6*7 = 42.".
+        final="The result of 6*7 is 42.",
+    ),
+]
+
+
+def build_convs(tasks=None):
+    """Two agent turns per task, serialized with the live loop's own wire
+    code (tools.ToolPrompt) — (messages, target reply) pairs."""
     from opsagent_tpu.tools import ToolAction, ToolPrompt
 
-    user1 = f"Here are the instructions: {INSTRUCTION}"
-    tp1 = ToolPrompt(
-        question=INSTRUCTION,
-        thought="I will count namespaces with kubectl.",
-        action=ToolAction(name="kubectl", input=KUBECTL_CMD),
-    )
-    reply1 = tp1.to_json()
+    convs = []
+    for t in tasks or TASKS_SINGLE:
+        user1 = f"Here are the instructions: {t['instruction']}"
+        tp1 = ToolPrompt(
+            question=t["instruction"],
+            thought=t["thought1"],
+            action=ToolAction(name=t["tool"], input=t["tool_input"]),
+        )
+        reply1 = tp1.to_json()
 
-    # Turn 2's user message is EXACTLY what the loop marshals back: the
-    # turn-1 ToolPrompt with the observation filled in (react.py:193-194;
-    # the replay kubectl prints 3 lines, `wc -l` -> "3").
-    tp1_obs = ToolPrompt(
-        question=tp1.question, thought=tp1.thought, action=tp1.action,
-        observation="3",
-    )
-    tp2 = ToolPrompt(
-        question=INSTRUCTION,
-        thought="The observation shows 3 namespaces.",
-        observation="The cluster has 3 namespaces.",
-        final_answer=FINAL_ANSWER,
-    )
-    reply2 = tp2.to_json()
+        # Turn 2's user message is EXACTLY what the loop marshals back:
+        # the turn-1 ToolPrompt with the observation filled in
+        # (react.py:193-194).
+        tp1_obs = ToolPrompt(
+            question=tp1.question, thought=tp1.thought, action=tp1.action,
+            observation=t["observation"],
+        )
+        tp2 = ToolPrompt(
+            question=t["instruction"],
+            thought=t["thought2"],
+            observation=t["obs2"],
+            final_answer=t["final"],
+        )
+        reply2 = tp2.to_json()
 
-    return [
-        ([{"role": "system", "content": SYS_PROMPT},
-          {"role": "user", "content": user1}], reply1),
-        ([{"role": "system", "content": SYS_PROMPT},
-          {"role": "user", "content": user1},
-          {"role": "assistant", "content": reply1},
-          {"role": "user", "content": tp1_obs.to_json()}], reply2),
-    ]
+        convs += [
+            ([{"role": "system", "content": SYS_PROMPT},
+              {"role": "user", "content": user1}], reply1),
+            ([{"role": "system", "content": SYS_PROMPT},
+              {"role": "user", "content": user1},
+              {"role": "assistant", "content": reply1},
+              {"role": "user", "content": tp1_obs.to_json()}], reply2),
+        ]
+    return convs
 
 
 def train_bpe_tokenizer(out_dir: str, extra_corpus: tuple[str, ...] = (),
-                        vocab_size: int = 512) -> str:
+                        vocab_size: int = 512, tasks=None) -> str:
     """Train a REAL byte-level-BPE tokenizer (HF fast-tokenizer format)
     on the agent corpus and save it loadable via AutoTokenizer — the demo
     then exercises the same HFTokenizer path real checkpoints use, not
@@ -113,7 +185,7 @@ def train_bpe_tokenizer(out_dir: str, extra_corpus: tuple[str, ...] = (),
     from opsagent_tpu.serving.chat_template import render_llama3
 
     corpus = list(extra_corpus)
-    for messages, reply in build_convs():
+    for messages, reply in build_convs(tasks):
         corpus.append(render_llama3(messages))
         corpus.append(reply)
     tok = Tokenizer(models.BPE(unk_token=None))
@@ -141,7 +213,7 @@ def train_bpe_tokenizer(out_dir: str, extra_corpus: tuple[str, ...] = (),
     return tok_dir
 
 
-def build_dataset(tok):
+def build_dataset(tok, tasks=None):
     """(token_ids, loss_mask) rows: prompts rendered by the SAME
     apply_chat_template the serving stack uses, targets validated
     reachable under the ToolPrompt FSM the serving path enforces."""
@@ -151,7 +223,7 @@ def build_dataset(tok):
         json_constraint,
     )
 
-    convs = build_convs()
+    convs = build_convs(tasks)
     con = json_constraint(tok, TOOLPROMPT_SCHEMA)
     for _, reply in convs:
         dfa = con.fsm.dfa
@@ -181,7 +253,13 @@ def main() -> int:
                          "real checkpoints use); byte = the test fallback")
     ap.add_argument("--skip-agent", action="store_true",
                     help="train + save only (no serving run)")
+    ap.add_argument("--tasks", default="single", choices=("single", "multi"),
+                    help="single = the original count-namespaces episode; "
+                         "multi = 6 instructions across kubectl AND the "
+                         "python tool (pods/nodes/version/arithmetic), "
+                         "each served and checked after training")
     args = ap.parse_args()
+    tasks = TASKS_MULTI if args.tasks == "multi" else TASKS_SINGLE
 
     import dataclasses
 
@@ -207,7 +285,7 @@ def main() -> int:
                   f"falling back to the byte tokenizer", file=sys.stderr)
             args.tokenizer = "byte"
     if args.tokenizer == "bpe":
-        tok_path = train_bpe_tokenizer(out)
+        tok_path = train_bpe_tokenizer(out, tasks=tasks)
         tok = load_tokenizer(tok_path)
         # The lm head sizes to the trained vocab (specials included).
         cfg = dataclasses.replace(cfg, vocab_size=tok.vocab_size)
@@ -216,7 +294,7 @@ def main() -> int:
     else:
         tok_path = ""
         tok = ByteTokenizer(vocab_size=cfg.vocab_size)
-    rows = build_dataset(tok)
+    rows = build_dataset(tok, tasks)
     S = 8 * ((max(len(ids) for ids, _ in rows) + 7) // 8)
     B = len(rows)
     tokens = np.full((B, S), tok.pad_id, np.int32)
@@ -253,19 +331,27 @@ def main() -> int:
     print(f"checkpoint saved: {ckpt}", file=sys.stderr)
     if args.skip_agent:
         return 0
-    ok = run_agent(ckpt, tok_path, cfg)
+    ok = run_agent(ckpt, tok_path, cfg, tasks)
     return 0 if ok else 1
 
 
-def run_agent(ckpt: str, tok_path: str, cfg) -> bool:
-    """Serve the trained checkpoint and run the real agent loop on it."""
+def run_agent(ckpt: str, tok_path: str, cfg, tasks=None) -> bool:
+    """Serve the trained checkpoint and run the real agent loop on EVERY
+    task's instruction, asserting each memorized final answer."""
     from opsagent_tpu.agent.react import assistant_with_config
     from opsagent_tpu.serving import api as serving_api
     from opsagent_tpu.serving.engine import Engine, EngineConfig
     from opsagent_tpu.tools import ToolPrompt
-    from opsagent_tpu.tools.replay import install_replay_kubectl
+    from opsagent_tpu.tools.replay import (
+        MULTI_TASK_SCRIPT,
+        NAMESPACES_SCRIPT,
+        install_replay_kubectl,
+    )
 
-    install_replay_kubectl()
+    tasks = tasks or TASKS_SINGLE
+    install_replay_kubectl(
+        MULTI_TASK_SCRIPT if len(tasks) > 1 else NAMESPACES_SCRIPT
+    )
 
     engine = Engine(
         EngineConfig(
@@ -284,22 +370,32 @@ def run_agent(ckpt: str, tok_path: str, cfg) -> bool:
     stack = serving_api.ServingStack(engine)
     serving_api.install_stack("tiny-agent", stack)
     try:
-        messages = [
-            {"role": "system", "content": SYS_PROMPT},
-            {"role": "user",
-             "content": f"Here are the instructions: {INSTRUCTION}"},
-        ]
-        answer, history = assistant_with_config(
-            "tpu://tiny-agent", messages, 256, False, True, 4, "", ""
-        )
-        print("--- transcript ---", file=sys.stderr)
-        for m in history:
-            print(f"[{m['role']}] {str(m['content'])[:300]}", file=sys.stderr)
-        final = ToolPrompt.from_json(answer).final_answer
-        print(f"final answer: {final!r}")
-        ok = "3" in final and "namespace" in final.lower()
-        print(f"agent {'PASSED' if ok else 'FAILED'}")
-        return ok
+        all_ok = True
+        for t in tasks:
+            messages = [
+                {"role": "system", "content": SYS_PROMPT},
+                {"role": "user",
+                 "content": f"Here are the instructions: {t['instruction']}"},
+            ]
+            answer, history = assistant_with_config(
+                "tpu://tiny-agent", messages, 256, False, True, 4, "", ""
+            )
+            print(f"--- transcript [{t['instruction']}] ---",
+                  file=sys.stderr)
+            for m in history:
+                print(f"[{m['role']}] {str(m['content'])[:300]}",
+                      file=sys.stderr)
+            try:
+                final = ToolPrompt.from_json(answer).final_answer
+            except ValueError:
+                final = ""
+            ok = final == t["final"]
+            all_ok = all_ok and ok
+            verdict = "PASSED" if ok else f"FAILED (want {t['final']!r})"
+            print(f"[{t['instruction']}] final answer: {final!r} {verdict}")
+        print(f"agent {'PASSED' if all_ok else 'FAILED'} "
+              f"({len(tasks)} tasks)")
+        return all_ok
     finally:
         stack.close()
         serving_api.uninstall_stack("tiny-agent")

@@ -15,6 +15,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -34,3 +36,70 @@ def test_train_serve_agent_roundtrip(tmp_path):
     assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
     assert "agent PASSED" in out.stdout
     assert (tmp_path / "ckpt" / "model.safetensors").exists()
+
+
+@pytest.mark.slow
+def test_train_serve_agent_multi_task(tmp_path):
+    """The 6-instruction corpus (5 kubectl episodes + 1 python-tool
+    episode) trains to memorization and the served agent answers EVERY
+    instruction correctly through the real loop — tool dispatch across
+    two tools, FSM-constrained decode, replay cluster."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [
+            sys.executable, "-u",
+            os.path.join(REPO, "scripts", "train_tiny_agent.py"),
+            "--tasks", "multi",
+            "--steps", "2000",
+            "--out", str(tmp_path / "ckpt"),
+        ],
+        capture_output=True, text=True, timeout=1500, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
+    assert "agent PASSED (6 tasks)" in out.stdout
+
+
+def test_multi_task_corpus_valid_under_fsm(tmp_path, monkeypatch):
+    """Every multi-task training target must be reachable under the
+    ToolPrompt FSM the serving path enforces, and every task's
+    observation must match what the REAL tool functions return against
+    the replay cluster — the same post-processed strings (noise filter,
+    strip, venv interpreter) the agent loop marshals into turn 2, so any
+    drift fails here in seconds instead of in the slow e2e run."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from train_tiny_agent import TASKS_MULTI, build_convs
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+
+    from opsagent_tpu.serving.constrained import (
+        TOOLPROMPT_SCHEMA,
+        json_constraint,
+    )
+    from opsagent_tpu.serving.tokenizer import ByteTokenizer
+    from opsagent_tpu.tools.kubectl import kubectl
+    from opsagent_tpu.tools.python_tool import python_repl
+    from opsagent_tpu.tools.replay import (
+        MULTI_TASK_SCRIPT,
+        install_replay_kubectl,
+    )
+
+    convs = build_convs(TASKS_MULTI)
+    assert len(convs) == 2 * len(TASKS_MULTI) == 12
+    con = json_constraint(ByteTokenizer(vocab_size=512), TOOLPROMPT_SCHEMA)
+    for _, reply in convs:
+        dfa = con.fsm.dfa
+        state = dfa.run(dfa.start, reply.encode())
+        assert state >= 0 and dfa.accept[state], reply
+
+    # monkeypatch records PATH so teardown restores it even though
+    # install_replay_kubectl mutates os.environ directly (same pattern
+    # as test_real_checkpoint.py's replay fixture).
+    monkeypatch.setenv("PATH", os.environ["PATH"])
+    install_replay_kubectl(MULTI_TASK_SCRIPT, str(tmp_path / "bin"))
+    tools = {"kubectl": kubectl, "python": python_repl}
+    for t in TASKS_MULTI:
+        got = tools[t["tool"]](t["tool_input"])
+        assert got == t["observation"], (t["tool_input"], got)
