@@ -616,15 +616,27 @@ def resolve_model(
 
 
 def hf_config_dict(cfg: ModelConfig) -> dict:
-    """``config.json`` contents for a dense ModelConfig — the inverse of
-    ``config_from_hf`` (checkpoint export; MoE/MLA export unsupported)."""
-    if cfg.moe or cfg.mla:
-        raise ValueError("hf_config_dict supports dense llama/qwen2 models")
+    """``config.json`` contents for a ModelConfig — the inverse of
+    ``config_from_hf`` (checkpoint export). Dense configs emit
+    llama/qwen2; MoE and/or MLA configs emit the deepseek family
+    (deepseek_v2/v3 when MLA is present, deepseek otherwise)."""
+    if cfg.mla:
+        mt = ("deepseek_v3" if cfg.moe and cfg.moe.scoring_func == "sigmoid"
+              else "deepseek_v2")
+    elif cfg.moe:
+        mt = "deepseek"
+    else:
+        mt = "qwen2" if cfg.attn_bias else "llama"
+    archs = {
+        "llama": "LlamaForCausalLM",
+        "qwen2": "Qwen2ForCausalLM",
+        "deepseek": "DeepseekForCausalLM",
+        "deepseek_v2": "DeepseekV2ForCausalLM",
+        "deepseek_v3": "DeepseekV3ForCausalLM",
+    }
     hf: dict = {
-        "model_type": "qwen2" if cfg.attn_bias else "llama",
-        "architectures": [
-            "Qwen2ForCausalLM" if cfg.attn_bias else "LlamaForCausalLM"
-        ],
+        "model_type": mt,
+        "architectures": [archs[mt]],
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": cfg.intermediate_size,
@@ -635,6 +647,9 @@ def hf_config_dict(cfg: ModelConfig) -> dict:
         "rms_norm_eps": cfg.rms_norm_eps,
         "tie_word_embeddings": cfg.tie_embeddings,
         "max_position_embeddings": cfg.max_position,
+        # Explicit so non-qwen2 model_types (the deepseek family) cannot
+        # silently drop q/k/v biases on a roundtrip.
+        "attention_bias": cfg.attn_bias,
     }
     if cfg.head_dim:
         hf["head_dim"] = cfg.head_dim
@@ -658,6 +673,30 @@ def hf_config_dict(cfg: ModelConfig) -> dict:
                 "mscale": rs.mscale,
                 "mscale_all_dim": rs.mscale_all_dim,
             }
+    if cfg.moe:
+        m = cfg.moe
+        hf.update({
+            "n_routed_experts": m.num_experts,
+            "num_experts_per_tok": m.num_experts_per_token,
+            "n_shared_experts": m.num_shared_experts,
+            "moe_intermediate_size": m.expert_intermediate_size,
+            "first_k_dense_replace": cfg.moe_layer_start,
+            "moe_layer_freq": 1,
+            "norm_topk_prob": m.norm_topk_prob,
+            "routed_scaling_factor": m.routed_scaling_factor,
+            "scoring_func": m.scoring_func,
+            "n_group": m.n_group,
+            "topk_group": m.topk_group,
+        })
+    if cfg.mla:
+        a = cfg.mla
+        hf.update({
+            "q_lora_rank": a.q_lora_rank or None,
+            "kv_lora_rank": a.kv_lora_rank,
+            "qk_nope_head_dim": a.qk_nope_head_dim,
+            "qk_rope_head_dim": a.qk_rope_head_dim,
+            "v_head_dim": a.v_head_dim,
+        })
     return hf
 
 

@@ -326,3 +326,160 @@ def test_restart_factory_keeps_auto_model_cfg():
         assert rebuilt.model_cfg.name == "no-such-preset"
     finally:
         stack.close()
+
+
+def test_hf_config_dict_roundtrips_moe_mla():
+    """Export side: a V3-shaped (MLA + sigmoid MoE) and a MoE-only config
+    roundtrip through hf_config_dict -> config_from_hf. The only allowed
+    delta is mla.latent_cache: derivation always serves V2/V3 with the
+    compressed latent pages."""
+    from opsagent_tpu.models.config import (
+        MLAConfig,
+        MoEConfig,
+        config_from_hf,
+        get_config_preset,
+        hf_config_dict,
+    )
+
+    v3ish = dataclasses.replace(
+        get_config_preset("tiny-mla"),
+        num_layers=3,
+        moe=MoEConfig(
+            num_experts=4, num_experts_per_token=2, num_shared_experts=1,
+            expert_intermediate_size=32, norm_topk_prob=True,
+            routed_scaling_factor=2.5, scoring_func="sigmoid",
+            n_group=2, topk_group=1,
+        ),
+        moe_layer_start=1,
+    )
+    moe_only = get_config_preset("tiny-moe")
+
+    import tempfile
+
+    for cfg, want_mt in ((v3ish, "deepseek_v3"), (moe_only, "deepseek")):
+        hf = hf_config_dict(cfg)
+        assert hf["model_type"] == want_mt
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "config.json"), "w") as f:
+                json.dump(hf, f)
+            back = config_from_hf(d, name=cfg.name)
+        assert back.moe == cfg.moe
+        if cfg.mla:
+            assert back.mla == dataclasses.replace(
+                cfg.mla, latent_cache=True
+            )
+            assert back.num_kv_heads == cfg.num_heads
+        for fld in ("vocab_size", "hidden_size", "intermediate_size",
+                    "num_layers", "num_heads", "moe_layer_start",
+                    "max_position"):
+            assert getattr(back, fld) == getattr(cfg, fld), fld
+
+
+@pytest.mark.slow
+def test_run_real_checkpoint_script_deepseek_auto(tmp_path):
+    """The auto path on a synthesized DeepSeek-V3-SHAPED release dir:
+    config.json (MLA + sigmoid MoE) -> config_from_hf -> loader (HF
+    deepseek weight names incl. router e_score_correction_bias) ->
+    latent-cache engine -> FSM-constrained agent loop. The same flow a
+    real V2-Lite/V3 download takes, at toy scale with random weights."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from train_tiny_agent import train_bpe_tokenizer
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+
+    from opsagent_tpu.agent.prompts import REACT_SYSTEM_PROMPT
+    from opsagent_tpu.models import llama
+    from opsagent_tpu.models.config import (
+        MoEConfig,
+        config_from_hf,
+        get_config_preset,
+        hf_config_dict,
+    )
+    from opsagent_tpu.models.loader import save_checkpoint
+    from opsagent_tpu.serving.tokenizer import load_tokenizer
+
+    ckpt_dir = tmp_path / "tiny-v3-release"
+    ckpt_dir.mkdir()
+    tok_dir = train_bpe_tokenizer(
+        str(ckpt_dir), extra_corpus=(REACT_SYSTEM_PROMPT,), vocab_size=2048
+    )
+    for fn in os.listdir(tok_dir):
+        shutil.move(os.path.join(tok_dir, fn), ckpt_dir / fn)
+    os.rmdir(tok_dir)
+    tok = load_tokenizer(str(ckpt_dir))
+
+    cfg = dataclasses.replace(
+        get_config_preset("tiny-mla"),
+        vocab_size=tok.vocab_size,
+        num_layers=3,
+        max_position=16384,
+        moe=MoEConfig(
+            num_experts=4, num_experts_per_token=2, num_shared_experts=1,
+            expert_intermediate_size=32, norm_topk_prob=True,
+            routed_scaling_factor=2.5, scoring_func="sigmoid",
+            n_group=2, topk_group=1,
+        ),
+        moe_layer_start=1,
+    )
+    with open(ckpt_dir / "config.json", "w") as f:
+        json.dump(hf_config_dict(cfg), f)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    params["moe_layers"]["router_bias"] = jnp.asarray(
+        np.linspace(-1, 1, 2 * 4).reshape(2, 4), jnp.float32
+    )
+    save_checkpoint(str(ckpt_dir / "model.safetensors"), params, cfg=cfg)
+
+    derived = config_from_hf(str(ckpt_dir))
+    assert derived.mla is not None and derived.mla.latent_cache
+    assert derived.moe is not None
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "run_real_checkpoint.py"),
+            "--checkpoint", str(ckpt_dir),
+            "--model-name", "auto",
+            "--max-iterations", "1",
+            "--num-pages", "2048",
+            "--max-pages-per-seq", "1024",
+            "--transcript", str(tmp_path / "transcript.md"),
+        ],
+        capture_output=True, text=True, timeout=1500, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
+    last = out.stdout.strip().splitlines()[-1]
+    assert json.loads(last)["ok"] is True
+    assert "config.json -> tiny-v3-release" in out.stderr
+
+
+def test_hf_config_dict_preserves_attn_bias_on_moe():
+    """A Qwen2-MoE-style config (moe set, attn_bias=True) exports as the
+    deepseek family but must keep attention_bias, or the re-imported
+    model would silently drop the q/k/v bias params."""
+    from opsagent_tpu.models.config import (
+        config_from_hf,
+        get_config_preset,
+        hf_config_dict,
+    )
+
+    cfg = dataclasses.replace(get_config_preset("tiny-moe"), attn_bias=True)
+    hf = hf_config_dict(cfg)
+    assert hf["model_type"] == "deepseek" and hf["attention_bias"] is True
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "config.json"), "w") as f:
+            json.dump(hf, f)
+        back = config_from_hf(d, name=cfg.name)
+    assert back.attn_bias is True
