@@ -1,0 +1,34 @@
+#!/bin/bash
+# One-shot on-TPU measurement session (PERF.md plan): run the moment the
+# tunneled chip is reachable. Captures, in order of importance:
+#   1. the staged bench (1B bf16, 8B int8 headline, config-5 sessions,
+#      speculative overhead, pallas-dma sweep, cold-restart TTFT) — every
+#      result line flushes immediately;
+#   2. a jax.profiler device trace of the 1B steady state for gap
+#      attribution (weight streaming vs attention vs sampling vs host).
+# Results land in $OUT (default ./tpu_results_<ts>).
+set -u
+OUT="${OUT:-tpu_results_$(date -u +%Y%m%dT%H%M%S)}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== probe ==" | tee "$OUT/session.log"
+timeout 300 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" \
+  2>&1 | tail -2 | tee -a "$OUT/session.log"
+if ! grep -q "^tpu" <(tail -2 "$OUT/session.log"); then
+  echo "tpu unreachable; aborting" | tee -a "$OUT/session.log"
+  exit 1
+fi
+
+echo "== staged bench (budget ${OPSAGENT_BENCH_BUDGET:-850}s) ==" | tee -a "$OUT/session.log"
+python bench.py > "$OUT/bench.jsonl" 2> >(tee -a "$OUT/session.log" >&2)
+echo "bench rc=$?" | tee -a "$OUT/session.log"
+
+echo "== profiled 1B steady state ==" | tee -a "$OUT/session.log"
+OPSAGENT_PROFILE_DIR="$OUT/trace" OPSAGENT_BENCH_MODEL=bench-1b \
+  OPSAGENT_BENCH_STEPS=256 timeout 600 python bench.py \
+  >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
+echo "profile rc=$?" | tee -a "$OUT/session.log"
+
+echo "results in $OUT:" | tee -a "$OUT/session.log"
+cat "$OUT/bench.jsonl"
