@@ -8,9 +8,9 @@
 #      attribution (weight streaming vs attention vs sampling vs host).
 # Results land in $OUT (default ./tpu_results_<ts>).
 set -u
+cd "$(dirname "$0")/.."
 OUT="${OUT:-tpu_results_$(date -u +%Y%m%dT%H%M%S)}"
 mkdir -p "$OUT"
-cd "$(dirname "$0")/.."
 
 echo "== probe ==" | tee "$OUT/session.log"
 timeout 300 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" \

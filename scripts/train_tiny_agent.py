@@ -62,7 +62,10 @@ FINAL_ANSWER = "There are 3 namespaces in the cluster."
 # Each task: one two-turn ReAct episode (tool call -> observation ->
 # final answer). ``observation`` must match BYTE-EXACTLY what the replay
 # tool emits at serve time (tools/replay.py MULTI_TASK_SCRIPT), or the
-# served turn-2 prompt diverges from the trained one.
+# served turn-2 prompt diverges from the trained one. Optional
+# ``phrasings`` lists alternative instruction wordings: all but the last
+# train (same episode, different question), the LAST is HELD OUT and
+# evaluated to probe phrasing robustness beyond memorization.
 TASKS_SINGLE = [dict(
     instruction=INSTRUCTION,
     tool="kubectl", tool_input=KUBECTL_CMD, observation="3",
@@ -72,9 +75,15 @@ TASKS_SINGLE = [dict(
     final=FINAL_ANSWER,
 )]
 
-TASKS_MULTI = TASKS_SINGLE + [
+TASKS_MULTI = [dict(
+    TASKS_SINGLE[0],
+    phrasings=["how many namespaces are there",
+               "tell me the number of namespaces"],
+)] + [
     dict(
         instruction="which pods are crashing",
+        phrasings=["list the crashing pods",
+                   "show me pods that keep crashing"],
         tool="kubectl",
         tool_input="kubectl get pods -A | grep CrashLoopBackOff",
         observation="web-2   CrashLoopBackOff",
@@ -85,6 +94,8 @@ TASKS_MULTI = TASKS_SINGLE + [
     ),
     dict(
         instruction="how many nodes are ready",
+        phrasings=["count the ready nodes",
+                   "what is the ready node count"],
         tool="kubectl",
         tool_input="kubectl get nodes --no-headers | grep -cw Ready",
         observation="2",
@@ -95,6 +106,8 @@ TASKS_MULTI = TASKS_SINGLE + [
     ),
     dict(
         instruction="what kubernetes version is the cluster running",
+        phrasings=["which k8s version is installed",
+                   "report the cluster version"],
         tool="kubectl",
         tool_input="kubectl version --short",
         observation="Server Version: v1.29.3",
@@ -105,6 +118,8 @@ TASKS_MULTI = TASKS_SINGLE + [
     ),
     dict(
         instruction="how many pods run in the default namespace",
+        phrasings=["count pods in the default namespace",
+                   "how many pods does default have"],
         tool="kubectl",
         tool_input="kubectl get pods -n default --no-headers | wc -l",
         observation="2",
@@ -115,6 +130,8 @@ TASKS_MULTI = TASKS_SINGLE + [
     ),
     dict(
         instruction="compute 6*7 using python",
+        phrasings=["use python to compute 6*7",
+                   "what is 6*7, computed with python"],
         tool="python",
         tool_input="print(6*7)",
         observation="42",
@@ -129,44 +146,58 @@ TASKS_MULTI = TASKS_SINGLE + [
 ]
 
 
+def train_phrasings(t) -> list[str]:
+    """Instruction wordings that TRAIN: the base instruction plus all but
+    the last alternative (the last is held out for the robustness probe)."""
+    return [t["instruction"], *t.get("phrasings", [])[:-1]]
+
+
+def heldout_phrasing(t) -> str | None:
+    phr = t.get("phrasings", [])
+    return phr[-1] if phr else None
+
+
 def build_convs(tasks=None):
-    """Two agent turns per task, serialized with the live loop's own wire
-    code (tools.ToolPrompt) — (messages, target reply) pairs."""
+    """Two agent turns per task PER TRAINED PHRASING, serialized with the
+    live loop's own wire code (tools.ToolPrompt) — (messages, target
+    reply) pairs. The question field carries the phrasing, so the model
+    learns the instruction -> episode mapping across wordings."""
     from opsagent_tpu.tools import ToolAction, ToolPrompt
 
     convs = []
     for t in tasks or TASKS_SINGLE:
-        user1 = f"Here are the instructions: {t['instruction']}"
-        tp1 = ToolPrompt(
-            question=t["instruction"],
-            thought=t["thought1"],
-            action=ToolAction(name=t["tool"], input=t["tool_input"]),
-        )
-        reply1 = tp1.to_json()
+        for phrasing in train_phrasings(t):
+            user1 = f"Here are the instructions: {phrasing}"
+            tp1 = ToolPrompt(
+                question=phrasing,
+                thought=t["thought1"],
+                action=ToolAction(name=t["tool"], input=t["tool_input"]),
+            )
+            reply1 = tp1.to_json()
 
-        # Turn 2's user message is EXACTLY what the loop marshals back:
-        # the turn-1 ToolPrompt with the observation filled in
-        # (react.py:193-194).
-        tp1_obs = ToolPrompt(
-            question=tp1.question, thought=tp1.thought, action=tp1.action,
-            observation=t["observation"],
-        )
-        tp2 = ToolPrompt(
-            question=t["instruction"],
-            thought=t["thought2"],
-            observation=t["obs2"],
-            final_answer=t["final"],
-        )
-        reply2 = tp2.to_json()
+            # Turn 2's user message is EXACTLY what the loop marshals
+            # back: the turn-1 ToolPrompt with the observation filled in
+            # (react.py:193-194).
+            tp1_obs = ToolPrompt(
+                question=tp1.question, thought=tp1.thought,
+                action=tp1.action, observation=t["observation"],
+            )
+            tp2 = ToolPrompt(
+                question=phrasing,
+                thought=t["thought2"],
+                observation=t["obs2"],
+                final_answer=t["final"],
+            )
+            reply2 = tp2.to_json()
 
-        convs += [
-            ([{"role": "system", "content": SYS_PROMPT},
-              {"role": "user", "content": user1}], reply1),
-            ([{"role": "system", "content": SYS_PROMPT},
-              {"role": "user", "content": user1},
-              {"role": "assistant", "content": reply1},
-              {"role": "user", "content": tp1_obs.to_json()}], reply2),
-        ]
+            convs += [
+                ([{"role": "system", "content": SYS_PROMPT},
+                  {"role": "user", "content": user1}], reply1),
+                ([{"role": "system", "content": SYS_PROMPT},
+                  {"role": "user", "content": user1},
+                  {"role": "assistant", "content": reply1},
+                  {"role": "user", "content": tp1_obs.to_json()}], reply2),
+            ]
     return convs
 
 
@@ -258,6 +289,9 @@ def main() -> int:
                          "multi = 6 instructions across kubectl AND the "
                          "python tool (pods/nodes/version/arithmetic), "
                          "each served and checked after training")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="skip the non-gating held-out-phrasing probes "
+                         "(each burns a full agent episode; CI uses this)")
     args = ap.parse_args()
     tasks = TASKS_MULTI if args.tasks == "multi" else TASKS_SINGLE
 
@@ -331,11 +365,12 @@ def main() -> int:
     print(f"checkpoint saved: {ckpt}", file=sys.stderr)
     if args.skip_agent:
         return 0
-    ok = run_agent(ckpt, tok_path, cfg, tasks)
+    ok = run_agent(ckpt, tok_path, cfg, tasks, probe=not args.no_probe)
     return 0 if ok else 1
 
 
-def run_agent(ckpt: str, tok_path: str, cfg, tasks=None) -> bool:
+def run_agent(ckpt: str, tok_path: str, cfg, tasks=None,
+              probe: bool = True) -> bool:
     """Serve the trained checkpoint and run the real agent loop on EVERY
     task's instruction, asserting each memorized final answer."""
     from opsagent_tpu.agent.react import assistant_with_config
@@ -369,32 +404,47 @@ def run_agent(ckpt: str, tok_path: str, cfg, tasks=None) -> bool:
     )
     stack = serving_api.ServingStack(engine)
     serving_api.install_stack("tiny-agent", stack)
+    def run_one(phrasing: str, t, tag: str = "") -> bool:
+        messages = [
+            {"role": "system", "content": SYS_PROMPT},
+            {"role": "user",
+             "content": f"Here are the instructions: {phrasing}"},
+        ]
+        answer, history = assistant_with_config(
+            "tpu://tiny-agent", messages, 256, False, True, 4, "", ""
+        )
+        label = f"{phrasing}{tag}"
+        print(f"--- transcript [{label}] ---", file=sys.stderr)
+        for m in history:
+            print(f"[{m['role']}] {str(m['content'])[:300]}",
+                  file=sys.stderr)
+        try:
+            final = ToolPrompt.from_json(answer).final_answer
+        except ValueError:
+            final = ""
+        ok = final == t["final"]
+        verdict = "PASSED" if ok else f"FAILED (want {t['final']!r})"
+        print(f"[{label}] final answer: {final!r} {verdict}")
+        return ok
+
     try:
         all_ok = True
+        held_total = held_ok = 0
         for t in tasks:
-            messages = [
-                {"role": "system", "content": SYS_PROMPT},
-                {"role": "user",
-                 "content": f"Here are the instructions: {t['instruction']}"},
-            ]
-            answer, history = assistant_with_config(
-                "tpu://tiny-agent", messages, 256, False, True, 4, "", ""
-            )
-            print(f"--- transcript [{t['instruction']}] ---",
-                  file=sys.stderr)
-            for m in history:
-                print(f"[{m['role']}] {str(m['content'])[:300]}",
-                      file=sys.stderr)
-            try:
-                final = ToolPrompt.from_json(answer).final_answer
-            except ValueError:
-                final = ""
-            ok = final == t["final"]
-            all_ok = all_ok and ok
-            verdict = "PASSED" if ok else f"FAILED (want {t['final']!r})"
-            print(f"[{t['instruction']}] final answer: {final!r} {verdict}")
+            for phrasing in train_phrasings(t):
+                all_ok = run_one(phrasing, t) and all_ok
+            held = heldout_phrasing(t)
+            if probe and held is not None:
+                # Robustness probe, reported but NOT gating: a tiny
+                # 2-layer model is not owed paraphrase generalization.
+                held_total += 1
+                if run_one(held, t, tag=" (HELD-OUT)"):
+                    held_ok += 1
         print(f"agent {'PASSED' if all_ok else 'FAILED'} "
               f"({len(tasks)} tasks)")
+        if held_total:
+            print(f"held-out phrasings: {held_ok}/{held_total} correct "
+                  f"(robustness probe, non-gating)")
         return all_ok
     finally:
         stack.close()

@@ -53,6 +53,7 @@ def test_train_serve_agent_multi_task(tmp_path):
             os.path.join(REPO, "scripts", "train_tiny_agent.py"),
             "--tasks", "multi",
             "--steps", "2000",
+            "--no-probe",  # held-out probes are demo-only wall clock
             "--out", str(tmp_path / "ckpt"),
         ],
         capture_output=True, text=True, timeout=1500, env=env, cwd=REPO,
@@ -70,7 +71,11 @@ def test_multi_task_corpus_valid_under_fsm(tmp_path, monkeypatch):
     drift fails here in seconds instead of in the slow e2e run."""
     sys.path.insert(0, os.path.join(REPO, "scripts"))
     try:
-        from train_tiny_agent import TASKS_MULTI, build_convs
+        from train_tiny_agent import (
+            TASKS_MULTI,
+            build_convs,
+            train_phrasings,
+        )
     finally:
         sys.path.remove(os.path.join(REPO, "scripts"))
 
@@ -87,7 +92,11 @@ def test_multi_task_corpus_valid_under_fsm(tmp_path, monkeypatch):
     )
 
     convs = build_convs(TASKS_MULTI)
-    assert len(convs) == 2 * len(TASKS_MULTI) == 12
+    # Two convs per TRAINED phrasing (base instruction + all but the
+    # held-out alternative): 6 tasks x 2 phrasings x 2 turns.
+    assert len(convs) == 2 * sum(
+        len(train_phrasings(t)) for t in TASKS_MULTI
+    ) == 24
     con = json_constraint(ByteTokenizer(vocab_size=512), TOOLPROMPT_SCHEMA)
     for _, reply in convs:
         dfa = con.fsm.dfa
