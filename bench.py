@@ -8,13 +8,15 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to six stages in isolated
+A plain `python bench.py` orchestrates up to seven stages in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
-the guaranteed number), then the bench-8b int8 headline, then the
-BASELINE config-5 concurrent-sessions run, then a speculative-decoding
-overhead run, a pallas-dma kernel comparison, and a cold-restart TTFT
-probe against the stage-1-primed compilation cache. EVERY result line is printed
+the guaranteed number), then the bench-8b int8 headline, an int4 variant
+of it (weight streaming halves again; the faster of the two becomes the
+headline), the BASELINE config-5 concurrent-sessions run, a
+speculative-decoding overhead run, a pallas-dma kernel comparison, and a
+cold-restart TTFT probe against the stage-1-primed compilation cache.
+EVERY result line is printed
 and flushed the moment it exists (the driver kills this process at an
 unknown wall clock; an already-earned number must survive), and a
 combined headline line is printed last. If the default preset dies —
@@ -149,11 +151,12 @@ def run_orchestrated() -> None:
     the driver's last-JSON-line parse picks it up.
 
     Order: default preset (bench-1b on TPU, tiny-test elsewhere — the
-    guaranteed number), then the bench-8b int8 headline, the BASELINE
-    config-5 concurrent-sessions run, a speculative-decoding overhead
-    run, the pallas-dma kernel comparison, and the cold-restart TTFT
-    probe; stages 2-6 only start if the remaining budget plausibly
-    covers them. Mode/spec env vars are stripped from stages
+    guaranteed number), then the bench-8b int8 headline and its int4
+    variant, the BASELINE config-5 concurrent-sessions run, a
+    speculative-decoding overhead run, the pallas-dma kernel comparison,
+    and the cold-restart TTFT probe; stages 2-7 only start if the
+    remaining budget plausibly covers them. Mode/spec env vars are
+    stripped from stages
     they don't belong to, so an operator-set OPSAGENT_BENCH_SPEC cannot
     contaminate the baseline stages."""
     budget = float(os.environ.get("OPSAGENT_BENCH_BUDGET", "850"))
@@ -170,6 +173,7 @@ def run_orchestrated() -> None:
         "OPSAGENT_BENCH_SPEC": None,
         "OPSAGENT_BENCH_MODE": None,
         "OPSAGENT_PAGED_BACKEND": None,
+        "OPSAGENT_BENCH_QUANT": None,
     }
 
     def stage(env_extra: dict, min_remaining: float, tag: str,
@@ -223,6 +227,16 @@ def run_orchestrated() -> None:
         if on_tpu else None
     if r8b is not None:
         headline = r8b
+    # int4 variant of the headline: weight streaming halves again vs
+    # int8, so if decode is weight-bound this stage should show it (and
+    # if not, the delta localizes the bottleneck to KV/attention/host).
+    r8b4 = stage(
+        {"OPSAGENT_BENCH_MODEL": "bench-8b",
+         "OPSAGENT_BENCH_QUANT": "int4"},
+        330, "8b-int4",
+    ) if on_tpu and r8b is not None else None
+    if r8b4 is not None and r8b4["value"] > r8b["value"]:
+        headline = r8b4
     rsess = stage(
         {"OPSAGENT_BENCH_MODE": "sessions",
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
@@ -266,6 +280,10 @@ def run_orchestrated() -> None:
     extra = dict(headline.get("extra", {}))
     if r1 is not None and headline is not r1:
         extra["bench_1b_tok_s_chip"] = r1["value"]
+    if r8b is not None and headline is not r8b:
+        extra["bench_8b_int8_tok_s_chip"] = r8b["value"]
+    if r8b4 is not None and headline is not r8b4:
+        extra["bench_8b_int4_tok_s_chip"] = r8b4["value"]
     if rsess is not None:
         extra["sessions_tok_s_chip"] = rsess["value"]
         extra["sessions_p50_ttft_ms"] = rsess.get("extra", {}).get(

@@ -171,10 +171,12 @@ def init_params(
 
 
 def init_params_random_int8(
-    cfg: ModelConfig, seed: int, dtype: jnp.dtype = jnp.bfloat16
+    cfg: ModelConfig, seed: int, dtype: jnp.dtype = jnp.bfloat16,
+    mode: str = "int8",
 ) -> Params:
-    """Random weights built DIRECTLY in the int8 serving form
-    (models.quant.QuantizedLinear), ON DEVICE, without ever materializing a
+    """Random weights built DIRECTLY in the quantized serving form
+    (models.quant.QuantizedLinear, or QuantizedLinear4 with
+    ``mode="int4"``), ON DEVICE, without ever materializing a
     full-precision tree and without any bulk host->device transfer.
 
     Why both constraints matter at 8B scale:
@@ -193,16 +195,21 @@ def init_params_random_int8(
     filled with ``lax.map`` over per-layer keys so peak transient memory
     is one layer slice, not a full-tensor wide intermediate.
     """
-    from .quant import QuantizedLinear
+    from .quant import QuantizedLinear, QuantizedLinear4
 
-    def qrand(key, shape: tuple[int, ...], fan_in: int) -> QuantizedLinear:
+    int4 = mode == "int4"
+
+    def qrand(key, shape: tuple[int, ...], fan_in: int):
         lead, mat = shape[:-2], shape[-2:]
 
         def gen(k):
+            bits = jax.random.bits(k, mat, jnp.uint8)
+            if int4:
+                # bits%15 in 0..14 minus 7 -> uniform int4 in [-7, 7].
+                return (bits.astype(jnp.int16) % 15 - 7).astype(jnp.int4)
             # bits%255 in 0..254 minus 127 -> uniform int8 in [-127, 127]
             # (the symmetric range quantize_weight produces; avoids the
             # int8-overflow trap of randint(maxval=128)).
-            bits = jax.random.bits(k, mat, jnp.uint8)
             return (bits.astype(jnp.int16) % 255 - 127).astype(jnp.int8)
 
         if lead:
@@ -213,6 +220,12 @@ def init_params_random_int8(
             q = q.reshape(*lead, *mat)
         else:
             q = gen(key)
+        if int4:
+            # ONE whole-axis scale group (random weights need no locality):
+            # std(U[-7,7]) = 7/sqrt3, matched to init_params' fan-in std.
+            s = float(fan_in**-0.5) * (3.0**0.5) / 7.0
+            scale = jnp.full(lead + (1, 1, mat[-1]), s, jnp.float32)
+            return QuantizedLinear4(q, scale)
         s = float(fan_in**-0.5) * (3.0**0.5) / 127.0
         scale = jnp.full(lead + (1, mat[-1]), s, jnp.float32)
         return QuantizedLinear(q, scale)
@@ -402,21 +415,22 @@ def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
 
 
 def _mm(x: jax.Array, w: Any) -> jax.Array:
-    """Matmul against a plain array or a weight-only-int8 QuantizedLinear
-    (models.quant): the dequantize multiplies fuse into the matmul operand
-    read under XLA, so quantized weights stream from HBM as int8."""
-    from .quant import QuantizedLinear
+    """Matmul against a plain array or a weight-only int8/int4
+    QuantizedLinear (models.quant): the dequantize multiplies fuse into
+    the matmul operand read under XLA, so quantized weights stream from
+    HBM in their narrow storage type."""
+    from .quant import QuantizedLinear, QuantizedLinear4
 
-    if isinstance(w, QuantizedLinear):
+    if isinstance(w, (QuantizedLinear, QuantizedLinear4)):
         return x @ w.dequantize().astype(x.dtype)
     return x @ w
 
 
 def _ein(sub: str, x: jax.Array, w: Any) -> jax.Array:
     """einsum twin of ``_mm`` for the batched expert matmuls."""
-    from .quant import QuantizedLinear
+    from .quant import QuantizedLinear, QuantizedLinear4
 
-    if isinstance(w, QuantizedLinear):
+    if isinstance(w, (QuantizedLinear, QuantizedLinear4)):
         return jnp.einsum(sub, x, w.dequantize().astype(x.dtype))
     return jnp.einsum(sub, x, w)
 
@@ -512,11 +526,11 @@ def _mla_kv_latent(x, lp, cfg: ModelConfig, cos, sin):
 
 def _dense_weight(w: Any) -> jax.Array:
     """Materialize a weight that code must reshape/slice (the MLA absorbed
-    path reshapes wukv per head): dequantizes int8 QuantizedLinear leaves
+    path reshapes wukv per head): dequantizes int8/int4 quantized leaves
     — XLA fuses the dequantize into the consuming einsum's operand read."""
-    from .quant import QuantizedLinear
+    from .quant import QuantizedLinear, QuantizedLinear4
 
-    if isinstance(w, QuantizedLinear):
+    if isinstance(w, (QuantizedLinear, QuantizedLinear4)):
         return w.dequantize()
     return w
 

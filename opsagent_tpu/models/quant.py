@@ -1,12 +1,16 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8 / int4 quantization for serving.
 
 The decode step is HBM-bandwidth-bound: every step streams the full weight
 set. Storing weights as int8 with per-output-channel symmetric scales
 halves that traffic (and halves the footprint — Llama-3-8B drops from
-~16 GB bf16, which does NOT fit a 16 GB v5e chip, to ~8 GB, which does).
-Compute stays on the bf16 MXU path: XLA fuses the dequantize
-(int8 -> bf16 multiply by scale) into the matmul operand read, so there is
-no separate materialized dequantized copy.
+~16 GB bf16, which does NOT fit a 16 GB v5e chip, to ~8 GB, which does);
+int4 with per-(group, output-channel) scales halves it AGAIN (~4 GB),
+roughly doubling the weight-streaming decode ceiling at the cost of more
+rounding error (group-wise scaling — default group 128 along the
+contraction axis — keeps that error local). Compute stays on the bf16
+MXU path: XLA fuses the dequantize (intN -> bf16 multiply by scale) into
+the matmul operand read, so there is no separate materialized
+dequantized copy; TPUs store s4 natively (two nibbles per byte of HBM).
 
 Design: a ``QuantizedLinear`` pytree leaf-pair {q: int8 [..., in, out],
 scale: [..., out]} that the model's matmul helper (``llama._mm``)
@@ -69,6 +73,63 @@ def quantize_weight(w: jax.Array) -> QuantizedLinear:
     return QuantizedLinear(q.astype(jnp.int8), scale.astype(jnp.float32))
 
 
+INT4_GROUP = 128  # contraction-axis group size (GPTQ/AWQ convention)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedLinear4:
+    """int4 weight + per-(contraction-group, output-channel) scale.
+
+    ``q`` is jnp.int4 [..., in, out] (XLA stores s4 packed two-per-byte);
+    ``scale`` is float32 [..., G, 1, out] with G = in // group. Dequantize
+    reshapes the contraction axis into (G, group) so each group's scale
+    broadcasts over its slice — XLA fuses the convert+multiply into the
+    matmul operand read exactly like the int8 path."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def dequantize(self) -> jax.Array:
+        *lead, In, Out = self.q.shape
+        G = self.scale.shape[-3]
+        w = self.q.astype(self.scale.dtype).reshape(*lead, G, In // G, Out)
+        return (w * self.scale).reshape(*lead, In, Out)
+
+
+def quantize_weight4(w: jax.Array, group: int = INT4_GROUP) -> QuantizedLinear4:
+    """Symmetric group-wise int4: the contraction axis splits into
+    ``group``-sized slices (falling back to one whole-axis group when it
+    does not divide — tiny test dims), scale = group absmax / 7, values
+    clipped to the symmetric [-7, 7] range."""
+    *lead, In, Out = w.shape
+    g = group if group and In % group == 0 else In
+    G = In // g
+    wg = w.astype(jnp.float32).reshape(*lead, G, g, Out)
+    absmax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)  # [..., G, 1, out]
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(wg / scale), -7, 7)
+    return QuantizedLinear4(
+        q.astype(jnp.int4).reshape(*lead, In, Out),
+        scale.astype(jnp.float32),
+    )
+
+
 # Weights worth quantizing: the big matmuls. Norm vectors, biases, and the
 # f32 router stay exact (tiny, and routing is precision-sensitive).
 _QUANT_KEYS = frozenset(
@@ -80,15 +141,19 @@ _QUANT_KEYS = frozenset(
 )
 
 
-def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
+def quantize_params(
+    params: dict[str, Any], mode: str = "int8"
+) -> dict[str, Any]:
     """Quantize every large linear in the stacked param tree (embed stays
     in compute dtype: its gather reads one row per token, not the whole
-    table, so int8 would save little and cost a per-token dequant).
+    table, so intN would save little and cost a per-token dequant).
+    ``mode``: "int8" (per-output-channel) or "int4" (group-wise).
 
     MUST run on host-resident weights for large models: the whole point
     is that the full-precision tree does not fit the chip — the engine
     loads/initializes under a CPU default device, quantizes there, and
-    only then device_puts the int8 tree onto the mesh."""
+    only then device_puts the quantized tree onto the mesh."""
+    quant = {"int8": quantize_weight, "int4": quantize_weight4}[mode]
 
     def walk(tree: dict[str, Any]) -> dict[str, Any]:
         out: dict[str, Any] = {}
@@ -96,7 +161,7 @@ def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
             if isinstance(leaf, dict):
                 out[key] = walk(leaf)
             elif key in _QUANT_KEYS:
-                out[key] = quantize_weight(leaf)
+                out[key] = quant(leaf)
             else:
                 out[key] = leaf
         return out
@@ -104,12 +169,17 @@ def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
     return walk(params)
 
 
-def quantize_specs(specs: dict[str, Any]) -> dict[str, Any]:
+def quantize_specs(
+    specs: dict[str, Any], mode: str = "int8"
+) -> dict[str, Any]:
     """PartitionSpec tree STRUCTURALLY matching ``quantize_params``' output
-    (quantized leaves become QuantizedLinear nodes whose children are the
-    weight's spec and the scale's spec, so jax.tree.map pairs them): the
-    int8 weight keeps its spec; the scale broadcasts over the contraction
-    axis (None) and shards with the weight's output axis."""
+    (quantized leaves become QuantizedLinear/QuantizedLinear4 nodes whose
+    children are the weight's spec and the scale's spec, so jax.tree.map
+    pairs them): the intN weight keeps its spec; the scale broadcasts
+    over the contraction axis and shards with the weight's output axis.
+    int4 group scales REPLICATE over the grouped contraction axis too —
+    they are tiny (In/group x Out floats), and sharding G would impose a
+    divisibility constraint on every (model dim, tp) pair."""
 
     def scale_spec(spec: P) -> P:
         # [..., in, out] weight -> [..., 1, out] scale: same rank; only
@@ -119,13 +189,22 @@ def quantize_specs(specs: dict[str, Any]) -> dict[str, Any]:
             parts[-2] = None
         return P(*parts)
 
+    def scale_spec4(spec: P) -> P:
+        # [..., in, out] weight -> [..., G, 1, out] scale: rank + 1, the
+        # G and broadcast axes unsharded, out follows the weight.
+        parts = list(spec)
+        return P(*parts[:-2], None, None, parts[-1])
+
     def walk(tree: dict[str, Any]) -> dict[str, Any]:
         out: dict[str, Any] = {}
         for key, leaf in tree.items():
             if isinstance(leaf, dict):
                 out[key] = walk(leaf)
             elif key in _QUANT_KEYS:
-                out[key] = QuantizedLinear(leaf, scale_spec(leaf))
+                if mode == "int4":
+                    out[key] = QuantizedLinear4(leaf, scale_spec4(leaf))
+                else:
+                    out[key] = QuantizedLinear(leaf, scale_spec(leaf))
             else:
                 out[key] = leaf
         return out

@@ -251,9 +251,10 @@ class Engine:
         self.mesh = make_mesh(tp=tp, dp=cfg.dp, sp=cfg.sp, ep=cfg.ep)
         self.lock = threading.RLock()
 
-        if cfg.quantize and cfg.quantize != "int8":
+        if cfg.quantize and cfg.quantize not in ("int8", "int4"):
             raise ValueError(
-                f"quantize={cfg.quantize!r}: only 'int8' is supported"
+                f"quantize={cfg.quantize!r}: supported values are "
+                f"'int8' (per-channel) and 'int4' (group-wise)"
             )
         key = jax.random.PRNGKey(cfg.seed)
         specs = llama.param_specs(self.model_cfg)
@@ -266,13 +267,14 @@ class Engine:
             from ..models.quant import quantize_specs
 
             log.warning(
-                "no checkpoint given: initializing RANDOM int8 weights "
-                "for %s", self.model_cfg.name,
+                "no checkpoint given: initializing RANDOM %s weights "
+                "for %s", cfg.quantize, self.model_cfg.name,
             )
             params = llama.init_params_random_int8(
-                self.model_cfg, cfg.seed, dtype=cfg.dtype
+                self.model_cfg, cfg.seed, dtype=cfg.dtype,
+                mode=cfg.quantize,
             )
-            specs = quantize_specs(specs)
+            specs = quantize_specs(specs, mode=cfg.quantize)
         else:
             # With quantization, checkpoint weights must be loaded and
             # quantized on the HOST: the full-precision tree is the thing
@@ -303,11 +305,13 @@ class Engine:
                 if cfg.quantize:
                     from ..models.quant import quantize_params, quantize_specs
 
-                    params = quantize_params(params)
-                    specs = quantize_specs(specs)
+                    params = quantize_params(params, mode=cfg.quantize)
+                    specs = quantize_specs(specs, mode=cfg.quantize)
                     log.info(
-                        "weights quantized to int8 "
-                        "(per-output-channel scales)"
+                        "weights quantized to %s (%s scales)",
+                        cfg.quantize,
+                        "per-output-channel" if cfg.quantize == "int8"
+                        else "group-wise",
                     )
         self.params = shard_params(params, specs, self.mesh)
         cache = llama.make_cache(

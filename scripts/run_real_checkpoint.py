@@ -49,7 +49,7 @@ def main() -> int:
                          "from the checkpoint dir's config.json "
                          "(models.config.config_from_hf)")
     ap.add_argument("--tokenizer", default="", help="defaults to the checkpoint dir")
-    ap.add_argument("--quantize", default="", choices=("", "int8"))
+    ap.add_argument("--quantize", default="", choices=("", "int8", "int4"))
     ap.add_argument("--tp", type=int, default=0)
     ap.add_argument("--num-pages", type=int, default=0,
                     help="KV page pool size (0 = engine default); raise "

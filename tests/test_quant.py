@@ -93,9 +93,109 @@ def test_engine_quantized_under_tp_mesh():
 
 
 def test_rejects_unknown_quantize():
-    with pytest.raises(ValueError, match="only 'int8'"):
+    with pytest.raises(ValueError, match="int8"):
         Engine(EngineConfig(
             model="tiny-test", dtype=jnp.float32, quantize="fp4",
             num_pages=16, page_size=4, max_pages_per_seq=4,
             prefill_buckets=(16,),
         ))
+
+
+# -- int4 (group-wise scales) ------------------------------------------------
+
+def test_quantize_weight4_roundtrip_error_bound():
+    from opsagent_tpu.models.quant import quantize_weight4
+
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((256, 96)) * 0.05, jnp.float32)
+    q = quantize_weight4(w, group=128)
+    assert q.q.dtype == jnp.int4
+    assert q.scale.shape == (2, 1, 96)  # 256 / 128 groups
+    err = np.abs(np.asarray(q.dequantize()) - np.asarray(w))
+    # Max error is half a step of the group's scale.
+    step = np.repeat(np.asarray(q.scale), 128, axis=-2).reshape(256, 96)
+    assert (err <= step / 2 + 1e-7).all()
+
+
+def test_quantize_weight4_group_fallback_on_indivisible_axis():
+    from opsagent_tpu.models.quant import quantize_weight4
+
+    w = jnp.asarray(np.random.default_rng(2).standard_normal((60, 8)),
+                    jnp.float32)
+    q = quantize_weight4(w, group=128)  # 60 % 128 != 0 -> one group
+    assert q.scale.shape == (1, 1, 8)
+    assert q.dequantize().shape == (60, 8)
+
+
+def test_int4_forward_close_to_fp():
+    from opsagent_tpu.models.quant import quantize_params
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qparams = quantize_params(params, mode="int4")
+    toks = jnp.asarray([[257, 72, 101, 108, 108, 111]], jnp.int32)
+    ref = np.asarray(llama.forward_full(params, CFG, toks, dtype=jnp.float32))
+    got = np.asarray(llama.forward_full(qparams, CFG, toks, dtype=jnp.float32))
+    # int4 is lossier than int8, and tiny-test's 64-dim contraction axes
+    # fall back to ONE whole-axis group (worst case for int4) — real
+    # models get 128-wide groups and much tighter error. The logits must
+    # still track the fp model strongly.
+    corr = np.corrcoef(ref.ravel(), got.ravel())[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_int4_specs_tree_matches_params_tree():
+    params = llama.init_params(
+        get_config_preset("tiny-moe"), jax.random.PRNGKey(0), jnp.float32
+    )
+    qparams = quantize_params(params, mode="int4")
+    qspecs = quantize_specs(
+        llama.param_specs(get_config_preset("tiny-moe")), mode="int4"
+    )
+    jax.tree.map(lambda a, b: None, qparams, qspecs)
+
+
+def test_engine_generate_int4():
+    kwargs = dict(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=64, max_pages_per_seq=16, max_batch_size=2,
+        prefill_buckets=(16, 32), prefix_cache=False,
+    )
+    q = Engine(EngineConfig(quantize="int4", **kwargs))
+    got = q.generate([[257, 5, 6, 7]], SamplingParams(max_tokens=6))[0]
+    assert len(got) >= 1
+
+
+def test_engine_int4_under_tp_mesh():
+    """int4 params must shard and execute on a tp=2 mesh (weight keeps
+    its spec; replicated group scales sidestep G-divisibility)."""
+    eng = Engine(EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=2, page_size=4,
+        num_pages=64, max_pages_per_seq=16, max_batch_size=2,
+        prefill_buckets=(16,), quantize="int4",
+    ))
+    assert eng.mesh.shape["tp"] == 2
+    out = eng.generate([[257, 1, 2, 3]], SamplingParams(max_tokens=4))
+    assert len(out[0]) >= 1
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_engine_quantized_mla_latent(mode):
+    """Quantized MLA latent-cache serving (DeepSeek-class): the absorbed
+    decode path reshapes wukv per head, so _dense_weight must dequantize
+    BOTH quantized classes — int4 regressed here once (review catch)."""
+    import dataclasses
+
+    base = get_config_preset("tiny-mla")
+    cfg = dataclasses.replace(
+        base, mla=dataclasses.replace(base.mla, latent_cache=True)
+    )
+    eng = Engine(
+        EngineConfig(
+            model="tiny-mla", dtype=jnp.float32, tp=1, page_size=4,
+            num_pages=64, max_pages_per_seq=16, max_batch_size=2,
+            prefill_buckets=(16,), quantize=mode,
+        ),
+        model_cfg=cfg,
+    )
+    out = eng.generate([[257, 1, 2, 3]], SamplingParams(max_tokens=4))
+    assert len(out[0]) >= 1
