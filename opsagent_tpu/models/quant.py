@@ -112,13 +112,36 @@ class QuantizedLinear4:
         return (w * self.scale).reshape(*lead, In, Out)
 
 
+def _group_size(In: int, group: int) -> int:
+    """Largest divisor of ``In`` that is <= ``group``: a contraction dim
+    that 128 does not divide (e.g. 4544 -> 64) still gets fine-grained
+    scales instead of silently collapsing to one whole-axis group (which
+    is exactly the fidelity regime group-wise int4 exists to avoid).
+    Dims with no divisor >= 16 fall back to the whole axis — per-element
+    scales would cost more HBM than the int4 saves — with a warning."""
+    for d in range(min(group, In), 0, -1):
+        if In % d == 0:
+            if d >= 16:
+                return d
+            break
+    import logging
+
+    logging.getLogger("opsagent.quant").warning(
+        "int4 group scaling degraded to ONE whole-axis group for a "
+        "%d-wide contraction axis (no divisor in [16, %d]); expect "
+        "int8-without-groups-level rounding error on these weights",
+        In, group,
+    )
+    return In
+
+
 def quantize_weight4(w: jax.Array, group: int = INT4_GROUP) -> QuantizedLinear4:
     """Symmetric group-wise int4: the contraction axis splits into
-    ``group``-sized slices (falling back to one whole-axis group when it
-    does not divide — tiny test dims), scale = group absmax / 7, values
-    clipped to the symmetric [-7, 7] range."""
+    ``group``-sized slices (``_group_size`` adapts to non-multiple dims),
+    scale = group absmax / 7, values clipped to the symmetric [-7, 7]
+    range."""
     *lead, In, Out = w.shape
-    g = group if group and In % group == 0 else In
+    g = _group_size(In, group) if group else In
     G = In // g
     wg = w.astype(jnp.float32).reshape(*lead, G, g, Out)
     absmax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)  # [..., G, 1, out]

@@ -199,3 +199,49 @@ def test_engine_quantized_mla_latent(mode):
     )
     out = eng.generate([[257, 1, 2, 3]], SamplingParams(max_tokens=4))
     assert len(out[0]) >= 1
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_engine_quantizes_loaded_checkpoint(mode, tmp_path):
+    """The HOST-side load->quantize->shard branch (the path real 8B
+    checkpoints take: the full-precision tree only ever exists on host):
+    greedy generation from the quantized engine must match quantizing
+    the same weights directly."""
+    from opsagent_tpu.models.loader import save_checkpoint
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(5), dtype=jnp.float32)
+    ckpt = tmp_path / "model.safetensors"
+    save_checkpoint(str(ckpt), params)
+
+    kwargs = dict(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=64, max_pages_per_seq=16, max_batch_size=2,
+        prefill_buckets=(16,), prefix_cache=False,
+    )
+    eng = Engine(EngineConfig(checkpoint=str(ckpt), quantize=mode, **kwargs))
+    got = eng.generate([[257, 9, 8, 7]], SamplingParams(max_tokens=5))[0]
+    # Oracle: hand the same in-memory fp tree to an engine with the same
+    # quantize mode (the engine quantizes caller-provided params too);
+    # the f32 save/load roundtrip is lossless, so outputs must be equal.
+    oracle = Engine(EngineConfig(quantize=mode, **kwargs), params=params)
+    want = oracle.generate([[257, 9, 8, 7]], SamplingParams(max_tokens=5))[0]
+    assert got == want
+
+
+def test_int4_group_size_adapts_to_non_multiples():
+    """A contraction dim that 128 does not divide still gets fine-grained
+    groups (largest divisor <= 128), not a whole-axis collapse; only
+    pathological dims with no usable divisor fall back, with a warning."""
+    from opsagent_tpu.models.quant import _group_size, quantize_weight4
+
+    assert _group_size(4544, 128) == 71    # Falcon-7B-style dim (2^6 * 71)
+    assert _group_size(192, 128) == 96
+    assert _group_size(4096, 128) == 128
+    assert _group_size(131, 128) == 131    # prime > group: whole axis
+
+    w = jnp.asarray(
+        np.random.default_rng(3).standard_normal((192, 8)), jnp.float32
+    )
+    q = quantize_weight4(w, group=128)
+    assert q.scale.shape == (2, 1, 8)      # 192 / 96 groups
+    assert q.dequantize().shape == (192, 8)
