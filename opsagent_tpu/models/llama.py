@@ -170,7 +170,7 @@ def init_params(
     )
 
 
-def init_params_random_int8(
+def init_params_random_quantized(
     cfg: ModelConfig, seed: int, dtype: jnp.dtype = jnp.bfloat16,
     mode: str = "int8",
 ) -> Params:
@@ -415,22 +415,22 @@ def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
 
 
 def _mm(x: jax.Array, w: Any) -> jax.Array:
-    """Matmul against a plain array or a weight-only int8/int4
-    QuantizedLinear (models.quant): the dequantize multiplies fuse into
-    the matmul operand read under XLA, so quantized weights stream from
-    HBM in their narrow storage type."""
-    from .quant import QuantizedLinear, QuantizedLinear4
+    """Matmul against a plain array or a weight-only quantized leaf
+    (models.quant, any width): the dequantize multiplies fuse into the
+    matmul operand read under XLA, so quantized weights stream from HBM
+    in their narrow storage type."""
+    from .quant import QuantizedBase
 
-    if isinstance(w, (QuantizedLinear, QuantizedLinear4)):
+    if isinstance(w, QuantizedBase):
         return x @ w.dequantize().astype(x.dtype)
     return x @ w
 
 
 def _ein(sub: str, x: jax.Array, w: Any) -> jax.Array:
     """einsum twin of ``_mm`` for the batched expert matmuls."""
-    from .quant import QuantizedLinear, QuantizedLinear4
+    from .quant import QuantizedBase
 
-    if isinstance(w, (QuantizedLinear, QuantizedLinear4)):
+    if isinstance(w, QuantizedBase):
         return jnp.einsum(sub, x, w.dequantize().astype(x.dtype))
     return jnp.einsum(sub, x, w)
 
@@ -526,11 +526,12 @@ def _mla_kv_latent(x, lp, cfg: ModelConfig, cos, sin):
 
 def _dense_weight(w: Any) -> jax.Array:
     """Materialize a weight that code must reshape/slice (the MLA absorbed
-    path reshapes wukv per head): dequantizes int8/int4 quantized leaves
-    — XLA fuses the dequantize into the consuming einsum's operand read."""
-    from .quant import QuantizedLinear, QuantizedLinear4
+    path reshapes wukv per head): dequantizes quantized leaves of any
+    width — XLA fuses the dequantize into the consuming einsum's operand
+    read."""
+    from .quant import QuantizedBase
 
-    if isinstance(w, (QuantizedLinear, QuantizedLinear4)):
+    if isinstance(w, QuantizedBase):
         return w.dequantize()
     return w
 

@@ -34,13 +34,15 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-@jax.tree_util.register_pytree_node_class
-class QuantizedLinear:
-    """int8 weight + per-output-channel scale; acts as a matmul rhs."""
+class QuantizedBase:
+    """Common shell of the quantized-weight pytree leaves: {q, scale}
+    pair, flattening, and array-like shape accessors. Model code
+    dispatches on THIS class (``llama._mm``/``_ein``/``_dense_weight``),
+    so adding a new width cannot silently miss a dispatch site."""
 
     def __init__(self, q: jax.Array, scale: jax.Array):
-        self.q = q          # int8, [..., in, out]
-        self.scale = scale  # float32, [..., 1, out]
+        self.q = q
+        self.scale = scale
 
     def tree_flatten(self):
         return (self.q, self.scale), None
@@ -56,6 +58,15 @@ class QuantizedLinear:
     @property
     def ndim(self):
         return self.q.ndim
+
+    def dequantize(self) -> jax.Array:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedLinear(QuantizedBase):
+    """int8 weight [..., in, out] + per-output-channel float32 scale
+    [..., 1, out]; acts as a matmul rhs."""
 
     def dequantize(self) -> jax.Array:
         return self.q.astype(self.scale.dtype) * self.scale
@@ -77,7 +88,7 @@ INT4_GROUP = 128  # contraction-axis group size (GPTQ/AWQ convention)
 
 
 @jax.tree_util.register_pytree_node_class
-class QuantizedLinear4:
+class QuantizedLinear4(QuantizedBase):
     """int4 weight + per-(contraction-group, output-channel) scale.
 
     ``q`` is jnp.int4 [..., in, out] (XLA stores s4 packed two-per-byte);
@@ -85,25 +96,6 @@ class QuantizedLinear4:
     reshapes the contraction axis into (G, group) so each group's scale
     broadcasts over its slice — XLA fuses the convert+multiply into the
     matmul operand read exactly like the int8 path."""
-
-    def __init__(self, q: jax.Array, scale: jax.Array):
-        self.q = q
-        self.scale = scale
-
-    def tree_flatten(self):
-        return (self.q, self.scale), None
-
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        return cls(*children)
-
-    @property
-    def shape(self):
-        return self.q.shape
-
-    @property
-    def ndim(self):
-        return self.q.ndim
 
     def dequantize(self) -> jax.Array:
         *lead, In, Out = self.q.shape

@@ -270,7 +270,7 @@ class Engine:
                 "no checkpoint given: initializing RANDOM %s weights "
                 "for %s", cfg.quantize, self.model_cfg.name,
             )
-            params = llama.init_params_random_int8(
+            params = llama.init_params_random_quantized(
                 self.model_cfg, cfg.seed, dtype=cfg.dtype,
                 mode=cfg.quantize,
             )

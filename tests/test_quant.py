@@ -245,3 +245,16 @@ def test_int4_group_size_adapts_to_non_multiples():
     q = quantize_weight4(w, group=128)
     assert q.scale.shape == (2, 1, 8)      # 192 / 96 groups
     assert q.dequantize().shape == (192, 8)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_engine_quantized_moe(mode):
+    """Quantized MoE serving: expert stacks ([L, E, in, out] leaves) go
+    through the _ein einsum dispatch; both widths must serve."""
+    eng = Engine(EngineConfig(
+        model="tiny-moe", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=64, max_pages_per_seq=16, max_batch_size=2,
+        prefill_buckets=(16,), quantize=mode,
+    ))
+    out = eng.generate([[257, 1, 2, 3]], SamplingParams(max_tokens=4))
+    assert len(out[0]) >= 1
