@@ -78,11 +78,15 @@ TASKS_SINGLE = [dict(
 TASKS_MULTI = [dict(
     TASKS_SINGLE[0],
     phrasings=["how many namespaces are there",
+               "count the namespaces in the cluster",
+               "give me the namespace count",
                "tell me the number of namespaces"],
 )] + [
     dict(
         instruction="which pods are crashing",
         phrasings=["list the crashing pods",
+                   "find pods stuck in a crash loop",
+                   "which pods keep restarting and crashing",
                    "show me pods that keep crashing"],
         tool="kubectl",
         tool_input="kubectl get pods -A | grep CrashLoopBackOff",
@@ -95,6 +99,8 @@ TASKS_MULTI = [dict(
     dict(
         instruction="how many nodes are ready",
         phrasings=["count the ready nodes",
+                   "how many nodes report ready",
+                   "number of nodes in the ready state",
                    "what is the ready node count"],
         tool="kubectl",
         tool_input="kubectl get nodes --no-headers | grep -cw Ready",
@@ -107,6 +113,8 @@ TASKS_MULTI = [dict(
     dict(
         instruction="what kubernetes version is the cluster running",
         phrasings=["which k8s version is installed",
+                   "what version of kubernetes is this",
+                   "tell me the kubernetes server version",
                    "report the cluster version"],
         tool="kubectl",
         tool_input="kubectl version --short",
@@ -119,6 +127,8 @@ TASKS_MULTI = [dict(
     dict(
         instruction="how many pods run in the default namespace",
         phrasings=["count pods in the default namespace",
+                   "number of pods in namespace default",
+                   "how many pods are running in default",
                    "how many pods does default have"],
         tool="kubectl",
         tool_input="kubectl get pods -n default --no-headers | wc -l",
@@ -131,6 +141,8 @@ TASKS_MULTI = [dict(
     dict(
         instruction="compute 6*7 using python",
         phrasings=["use python to compute 6*7",
+                   "run python to calculate 6*7",
+                   "calculate 6*7 with the python tool",
                    "what is 6*7, computed with python"],
         tool="python",
         tool_input="print(6*7)",
@@ -292,6 +304,10 @@ def main() -> int:
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the non-gating held-out-phrasing probes "
                          "(each burns a full agent episode; CI uses this)")
+    ap.add_argument("--wide", action="store_true",
+                    help="4x the model (d=128, f=256, 8 heads): the "
+                         "capacity experiment for held-out phrasing "
+                         "generalization (slower to train)")
     args = ap.parse_args()
     tasks = TASKS_MULTI if args.tasks == "multi" else TASKS_SINGLE
 
@@ -310,6 +326,11 @@ def main() -> int:
     out = args.out or tempfile.mkdtemp(prefix="opsagent-tiny-agent-")
     os.makedirs(out, exist_ok=True)
     cfg = get_config_preset("tiny-test")
+    if args.wide:
+        cfg = dataclasses.replace(
+            cfg, hidden_size=128, intermediate_size=256, num_heads=8,
+            num_kv_heads=4,
+        )
     if args.tokenizer == "bpe":
         try:
             import tokenizers  # noqa: F401 - probe the optional dep
@@ -405,15 +426,24 @@ def run_agent(ckpt: str, tok_path: str, cfg, tasks=None,
     stack = serving_api.ServingStack(engine)
     serving_api.install_stack("tiny-agent", stack)
     def run_one(phrasing: str, t, tag: str = "") -> bool:
+        label = f"{phrasing}{tag}"
         messages = [
             {"role": "system", "content": SYS_PROMPT},
             {"role": "user",
              "content": f"Here are the instructions: {phrasing}"},
         ]
-        answer, history = assistant_with_config(
-            "tpu://tiny-agent", messages, 256, False, True, 4, "", ""
-        )
-        label = f"{phrasing}{tag}"
+        try:
+            answer, history = assistant_with_config(
+                "tpu://tiny-agent", messages, 256, False, True, 4, "", ""
+            )
+        except Exception as e:  # noqa: BLE001 - a mis-routed probe can
+            # loop until the page budget rejects its grown history; that
+            # is a FAILED probe, not a crashed demo. GATING runs re-raise:
+            # an engine fault there needs its traceback, not a one-liner.
+            if not tag:
+                raise
+            print(f"[{label}] agent error: {e} FAILED")
+            return False
         print(f"--- transcript [{label}] ---", file=sys.stderr)
         for m in history:
             print(f"[{m['role']}] {str(m['content'])[:300]}",

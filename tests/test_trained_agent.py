@@ -93,10 +93,10 @@ def test_multi_task_corpus_valid_under_fsm(tmp_path, monkeypatch):
 
     convs = build_convs(TASKS_MULTI)
     # Two convs per TRAINED phrasing (base instruction + all but the
-    # held-out alternative): 6 tasks x 2 phrasings x 2 turns.
+    # held-out alternative): 6 tasks x 4 phrasings x 2 turns.
     assert len(convs) == 2 * sum(
         len(train_phrasings(t)) for t in TASKS_MULTI
-    ) == 24
+    ) == 48
     con = json_constraint(ByteTokenizer(vocab_size=512), TOOLPROMPT_SCHEMA)
     for _, reply in convs:
         dfa = con.fsm.dfa
