@@ -116,9 +116,9 @@ def _group_size(In: int, group: int) -> int:
             if d >= 16:
                 return d
             break
-    import logging
+    from ..utils.logger import get_logger
 
-    logging.getLogger("opsagent.quant").warning(
+    get_logger("quant").warning(
         "int4 group scaling degraded to ONE whole-axis group for a "
         "%d-wide contraction axis (no divisor in [16, %d]); expect "
         "int8-without-groups-level rounding error on these weights",
