@@ -255,13 +255,16 @@ def run_orchestrated() -> None:
         180, "spec",
     ) if on_tpu else None
     # Kernel comparison (PERF.md plan item 2): the manual-DMA Pallas
-    # paged-attention backend on the same 1B preset; value vs stage 1
-    # (xla gather) decides the default (ops/attention.py).
+    # paged-attention backend on the 8B int8 preset — the headline shape,
+    # and the one whose head_dim (128) satisfies the kernel's Mosaic
+    # alignment requirement (bench-1b's head_dim=64 cannot compile it;
+    # r04 on-chip). Value vs the r8b stage (xla gather) decides the
+    # default (ops/attention.py).
     rdma = stage(
-        {"OPSAGENT_BENCH_MODEL": "bench-1b",
+        {"OPSAGENT_BENCH_MODEL": "bench-8b",
          "OPSAGENT_PAGED_BACKEND": "pallas-dma"},
-        150, "pallas-dma",
-    ) if on_tpu else None
+        330, "pallas-dma",
+    ) if on_tpu and r8b is not None else None
     # Cold-restart TTFT proof (VERDICT r03 #9): stage 1 primed the
     # persistent compilation cache; this fresh process re-inits the same
     # preset, so its init_s/warmup_s/first_ttft_ms ARE the

@@ -195,7 +195,7 @@ def init_params_random_quantized(
     filled with ``lax.map`` over per-layer keys so peak transient memory
     is one layer slice, not a full-tensor wide intermediate.
     """
-    from .quant import QuantizedLinear, QuantizedLinear4
+    from .quant import QuantizedLinear, QuantizedLinear4, pack_int4
 
     int4 = mode == "int4"
 
@@ -205,19 +205,21 @@ def init_params_random_quantized(
         def gen(k):
             bits = jax.random.bits(k, mat, jnp.uint8)
             if int4:
-                # bits%15 in 0..14 minus 7 -> uniform int4 in [-7, 7].
-                return (bits.astype(jnp.int16) % 15 - 7).astype(jnp.int4)
+                # bits%15 in 0..14 minus 7 -> uniform int4 in [-7, 7],
+                # packed two-per-int8-byte (QuantizedLinear4's storage).
+                return pack_int4(bits.astype(jnp.int16) % 15 - 7)
             # bits%255 in 0..254 minus 127 -> uniform int8 in [-127, 127]
             # (the symmetric range quantize_weight produces; avoids the
             # int8-overflow trap of randint(maxval=128)).
             return (bits.astype(jnp.int16) % 255 - 127).astype(jnp.int8)
 
+        stored = (mat[0] // 2, mat[1]) if int4 else mat
         if lead:
             n = 1
             for x in lead:
                 n *= x
             q = jax.lax.map(gen, jax.random.split(key, n))
-            q = q.reshape(*lead, *mat)
+            q = q.reshape(*lead, *stored)
         else:
             q = gen(key)
         if int4:

@@ -10,7 +10,8 @@ rounding error (group-wise scaling — default group 128 along the
 contraction axis — keeps that error local). Compute stays on the bf16
 MXU path: XLA fuses the dequantize (intN -> bf16 multiply by scale) into
 the matmul operand read, so there is no separate materialized
-dequantized copy; TPUs store s4 natively (two nibbles per byte of HBM).
+dequantized copy; int4 values travel two-nibbles-per-byte in
+self-packed int8 (see QuantizedLinear4).
 
 Design: a ``QuantizedLinear`` pytree leaf-pair {q: int8 [..., in, out],
 scale: [..., out]} that the model's matmul helper (``llama._mm``)
@@ -91,17 +92,49 @@ INT4_GROUP = 128  # contraction-axis group size (GPTQ/AWQ convention)
 class QuantizedLinear4(QuantizedBase):
     """int4 weight + per-(contraction-group, output-channel) scale.
 
-    ``q`` is jnp.int4 [..., in, out] (XLA stores s4 packed two-per-byte);
-    ``scale`` is float32 [..., G, 1, out] with G = in // group. Dequantize
-    reshapes the contraction axis into (G, group) so each group's scale
-    broadcasts over its slice — XLA fuses the convert+multiply into the
-    matmul operand read exactly like the int8 path."""
+    ``q`` is int8 [..., in/2, out] with TWO int4 values packed per byte
+    along the contraction axis (low nibble = even row, high nibble = odd
+    row); ``scale`` is float32 [..., G, 1, out] with G = in // group.
+    Self-packed int8 rather than native jnp.int4 because jit-argument
+    resharding of sub-byte arrays recursively re-enters jit inside
+    device_put (measured on-chip r04: RecursionError at the 8B int4
+    bench's first prefill) — the byte-level HBM traffic is identical
+    (4 bits/weight) and XLA fuses the unpack shifts into the consuming
+    matmul's operand read. Dequantize unpacks, then reshapes the
+    contraction axis into (G, group) so each group's scale broadcasts
+    over its slice, exactly like the int8 path."""
+
+    @property
+    def shape(self):
+        *lead, half, out = self.q.shape
+        return (*lead, 2 * half, out)
 
     def dequantize(self) -> jax.Array:
-        *lead, In, Out = self.q.shape
+        *lead, half, Out = self.q.shape
+        In = 2 * half
         G = self.scale.shape[-3]
-        w = self.q.astype(self.scale.dtype).reshape(*lead, G, In // G, Out)
+        # Arithmetic shifts sign-extend on int8: (p << 4) >> 4 recovers
+        # the low nibble's signed value, p >> 4 the high nibble's.
+        low = jax.lax.shift_right_arithmetic(
+            jax.lax.shift_left(self.q, jnp.int8(4)), jnp.int8(4)
+        )
+        high = jax.lax.shift_right_arithmetic(self.q, jnp.int8(4))
+        w = jnp.stack([low, high], axis=-2)  # [..., in/2, 2, out]
+        w = w.astype(self.scale.dtype).reshape(*lead, G, In // G, Out)
         return (w * self.scale).reshape(*lead, In, Out)
+
+
+def pack_int4(q: jax.Array) -> jax.Array:
+    """[-8, 7]-valued int8 [..., in, out] -> packed int8 [..., in/2, out]
+    (even rows in the low nibble, odd rows in the high). ``in`` must be
+    even — every transformer contraction dim is."""
+    *lead, In, Out = q.shape
+    if In % 2:
+        raise ValueError(f"int4 packing needs an even contraction dim, got {In}")
+    q = q.astype(jnp.int8).reshape(*lead, In // 2, 2, Out)
+    low = q[..., 0, :] & jnp.int8(0x0F)
+    high = jax.lax.shift_left(q[..., 1, :], jnp.int8(4))
+    return high | low
 
 
 def _group_size(In: int, group: int) -> int:
@@ -140,7 +173,7 @@ def quantize_weight4(w: jax.Array, group: int = INT4_GROUP) -> QuantizedLinear4:
     scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
     q = jnp.clip(jnp.round(wg / scale), -7, 7)
     return QuantizedLinear4(
-        q.astype(jnp.int4).reshape(*lead, In, Out),
+        pack_int4(q.astype(jnp.int8).reshape(*lead, In, Out)),
         scale.astype(jnp.float32),
     )
 

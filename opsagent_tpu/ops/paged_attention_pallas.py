@@ -255,7 +255,17 @@ def paged_decode_attention_pallas_dma(
     layer: jax.Array | None = None,  # [] int32 with the layer-axis form
 ) -> jax.Array:
     """Manual-DMA paged decode attention: grid (B,), double-buffered page
-    streaming. Same contract as ``paged_decode_attention_pallas``."""
+    streaming. Same contract as ``paged_decode_attention_pallas``.
+
+    Requires ``head_dim % 128 == 0``: Mosaic's manual-DMA memref slices
+    must be 128-aligned on the minormost dim (r04 on-chip: head_dim=64
+    fails to compile). Callers with smaller heads should use the grid
+    kernel or the xla gather (engine auto-falls-back)."""
+    if q.shape[-1] % 128 != 0 and not interpret:
+        raise ValueError(
+            f"pallas-dma needs head_dim % 128 == 0, got {q.shape[-1]}; "
+            f"use impl='pallas' or 'xla'"
+        )
     if k_pages.ndim == 5:
         Lr, N, P, K, D = k_pages.shape
         k_pages = k_pages.reshape(Lr * N, P, K, D)

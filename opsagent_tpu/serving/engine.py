@@ -338,6 +338,20 @@ class Engine:
                 self.attn_impl,
             )
             self.attn_impl = "xla"
+        if (
+            self.attn_impl == "pallas-dma"
+            and self.model_cfg.head_dim_ % 128 != 0
+        ):
+            # Mosaic requires manual-DMA memref slices to be 128-aligned
+            # on the minormost dim (measured on-chip r04: bench-1b's
+            # head_dim=64 fails to compile with "Slice shape along
+            # dimension 3 must be aligned to tiling (128)").
+            log.info(
+                "pallas-dma needs head_dim %% 128 == 0 (got %d): "
+                "falling back to xla paged attention",
+                self.model_cfg.head_dim_,
+            )
+            self.attn_impl = "xla"
         log.info(
             "paged decode attention impl: %s (tp=%d%s)",
             self.attn_impl, tp,

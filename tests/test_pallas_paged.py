@@ -251,6 +251,33 @@ def test_pallas_dma_layer_form():
         )
 
 
+def test_pallas_dma_rejects_unaligned_head_dim():
+    """Compiled mode refuses head_dim % 128 != 0 up front (Mosaic's
+    manual-DMA slices must be 128-aligned on the minormost dim; r04
+    on-chip failure) instead of a deep Mosaic error."""
+    rng = np.random.default_rng(11)
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B=1, H=4, K=2, D=64, P=8, MaxP=2, num_pages=4, lengths=[8]
+    )
+    with pytest.raises(ValueError, match="head_dim"):
+        paged_decode_attention_pallas_dma(
+            q, k_pages, v_pages, table, lens, interpret=False
+        )
+
+
+def test_engine_falls_back_from_pallas_dma_on_small_head_dim(monkeypatch):
+    """tiny-test (head_dim 16) + OPSAGENT_PAGED_BACKEND=pallas-dma must
+    resolve to the xla gather, not die in Mosaic at first prefill."""
+    monkeypatch.setenv("OPSAGENT_PAGED_BACKEND", "pallas-dma")
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(
+        model="tiny-test", max_batch_size=2, num_pages=16, page_size=8,
+        max_pages_per_seq=4, prefill_buckets=(16,), decode_block=4,
+    ))
+    assert eng.attn_impl == "xla"
+
+
 def test_pallas_dma_length_beyond_table_clamps():
     """lengths > MaxP*P (tolerated by the grid kernel via clamping) must
     not read the page table out of bounds or leak a prefetch DMA."""

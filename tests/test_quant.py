@@ -109,12 +109,35 @@ def test_quantize_weight4_roundtrip_error_bound():
     rng = np.random.default_rng(1)
     w = jnp.asarray(rng.standard_normal((256, 96)) * 0.05, jnp.float32)
     q = quantize_weight4(w, group=128)
-    assert q.q.dtype == jnp.int4
+    # Self-packed storage: int8 bytes, two nibbles each, half the rows.
+    assert q.q.dtype == jnp.int8
+    assert q.q.shape == (128, 96)
+    assert q.shape == (256, 96)
     assert q.scale.shape == (2, 1, 96)  # 256 / 128 groups
     err = np.abs(np.asarray(q.dequantize()) - np.asarray(w))
     # Max error is half a step of the group's scale.
     step = np.repeat(np.asarray(q.scale), 128, axis=-2).reshape(256, 96)
     assert (err <= step / 2 + 1e-7).all()
+
+
+def test_pack_int4_roundtrip_exact():
+    """pack -> dequantize(scale=1) must reproduce every value in [-8, 7],
+    including the sign-extension of negative nibbles in both positions."""
+    from opsagent_tpu.models.quant import QuantizedLinear4, pack_int4
+
+    vals = np.arange(-8, 8, dtype=np.int8)          # every nibble value
+    w = np.stack([vals, vals[::-1]], axis=-1)       # [16, 2]
+    packed = pack_int4(jnp.asarray(w))
+    assert packed.dtype == jnp.int8 and packed.shape == (8, 2)
+    q = QuantizedLinear4(packed, jnp.ones((1, 1, 2), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(q.dequantize()), w.astype(np.float32))
+
+
+def test_pack_int4_odd_contraction_dim_rejected():
+    from opsagent_tpu.models.quant import pack_int4
+
+    with np.testing.assert_raises(ValueError):
+        pack_int4(jnp.zeros((7, 4), jnp.int8))
 
 
 def test_quantize_weight4_group_fallback_on_indivisible_axis():
