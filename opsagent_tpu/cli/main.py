@@ -105,6 +105,14 @@ def build_parser() -> argparse.ArgumentParser:
              "fidelity cost",
     )
     se.add_argument(
+        "--kv-quantize",
+        default="",
+        choices=("", "int8"),
+        help="KV-cache quantization: int8 pages + per-token scales halve "
+             "decode-step KV reads (the dominant non-weight HBM term at "
+             "serving shapes); not supported for MLA models",
+    )
+    se.add_argument(
         "--platform",
         default="",
         choices=("", "tpu", "cpu"),
@@ -199,6 +207,7 @@ def main(argv: list[str] | None = None) -> int:
             ep=args.ep,
             max_batch_size=args.max_batch_size,
             quantize=args.quantize,
+            kv_quantize=args.kv_quantize,
             speculative_k=args.speculative_k,
         )
         return 0

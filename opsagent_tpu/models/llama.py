@@ -340,14 +340,23 @@ def make_cache(
     num_pages: int,
     page_size: int,
     dtype: jnp.dtype = jnp.bfloat16,
+    kv_quantize: str = "",
 ) -> Params:
     """Paged KV cache pytree: pages stacked over layers. MLA latent mode
     stores ONE (kv_lora_rank + rope)-dim latent per token in ``k`` — the
     compression that motivates MLA — with a 1-dim placeholder ``v`` (the
     pytree shape is shared with the standard layout so the engine's
-    donation/restart plumbing is layout-agnostic)."""
+    donation/restart plumbing is layout-agnostic).
+
+    ``kv_quantize="int8"`` stores pages as ``ops.attention.QuantizedPages``
+    (int8 values + per-token-per-head f32 scales): halves decode KV reads,
+    the dominant non-weight HBM term at serving shapes (PERF.md). Not
+    supported for the MLA latent layout (latents feed weight-absorbed
+    matmuls, not raw attention; the engine rejects the combination)."""
     L = cfg.num_layers
     if _latent_cache(cfg):
+        if kv_quantize:
+            raise ValueError("kv_quantize is not supported with MLA latent cache")
         shape_k = (L, num_pages, page_size, 1, cfg.mla.latent_dim)
         shape_v = (L, num_pages, page_size, 1, 1)
         return {
@@ -355,18 +364,42 @@ def make_cache(
         }
     K, D = cfg.num_kv_heads, cfg.head_dim_
     shape = (L, num_pages, page_size, K, D)
+    if kv_quantize:
+        if kv_quantize != "int8":
+            raise ValueError(f"unsupported kv_quantize {kv_quantize!r}")
+        from ..ops.attention import QuantizedPages
+
+        return {
+            "k": QuantizedPages(
+                jnp.zeros(shape, jnp.int8),
+                jnp.ones(shape[:-1], jnp.float32),
+            ),
+            "v": QuantizedPages(
+                jnp.zeros(shape, jnp.int8),
+                jnp.ones(shape[:-1], jnp.float32),
+            ),
+        }
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def cache_specs(cfg: ModelConfig) -> Params:
+def cache_specs(cfg: ModelConfig, kv_quantize: str = "") -> Params:
     """KV pages are sharded over the kv-head axis (tp), like wk/wv. The
     MLA latent cache has ONE shared 'head' — replicated over tp (it is
-    per-token global state; queries/outputs still shard over heads)."""
+    per-token global state; queries/outputs still shard over heads).
+    Quantized pages: the scale plane drops the head-dim axis but keeps
+    the kv-head axis, so it shards with its values."""
     if _latent_cache(cfg):
         return {
             "k": P(None, None, None, None, None),
             "v": P(None, None, None, None, None),
         }
+    if kv_quantize:
+        from ..ops.attention import QuantizedPages
+
+        spec = QuantizedPages(
+            P(None, None, None, "tp", None), P(None, None, None, "tp")
+        )
+        return {"k": spec, "v": spec}
     return {
         "k": P(None, None, None, "tp", None),
         "v": P(None, None, None, "tp", None),

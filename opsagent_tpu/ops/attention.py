@@ -23,6 +23,64 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
+@jax.tree_util.register_pytree_node_class
+class QuantizedPages:
+    """int8 KV pages + per-(slot, token, head) float32 scales.
+
+    At the 8B bench shape KV reads (~4 GB/step at 4k context, B=32) rival
+    the int4 weight stream (PERF.md), so halving them is the next decode
+    lever after weight quantization. ``q`` keeps the page layout
+    [L, N, P, K, D] (or [N, P, K, D]) in int8; ``scale`` drops the D axis:
+    one symmetric absmax scale per written token per kv head — 4 bytes per
+    D-row, ~3 % traffic overhead at D=128, and near-lossless for attention
+    (per-token scaling keeps rounding error local, the same locality
+    argument as group-wise int4 weights).
+
+    A registered pytree node, so it flows through lax.scan carries,
+    shard_params, donation, and engine restart plumbing exactly like a
+    plain page array. Readers dequantize AFTER their page gather — XLA
+    fuses the convert+multiply into the attention matmul's operand read,
+    so HBM sees int8 pages + small scales, never a dequantized copy."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize_kv_rows(new: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[B, S, K, D] fresh K/V -> (int8 values, [B, S, K] f32 scales):
+    symmetric absmax over the head dim, the write-side half of
+    ``QuantizedPages``."""
+    absmax = jnp.max(jnp.abs(new.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.round(new.astype(jnp.float32) / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def _dequantize_gathered(seq: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Gathered int8 [..., K, D] + scales [..., K] -> compute dtype."""
+    return (seq.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def paged_attention_backend() -> str:
     """Which decode-attention implementation to use: "pallas" (TPU kernel)
     or "xla" (gather-based reference). Env OPSAGENT_PAGED_BACKEND overrides;
@@ -132,6 +190,11 @@ def paged_decode_attention_auto(
     ``paged_attention_backend``, resolved at trace time by the caller).
     With a mesh whose tp axis is >1, the Pallas path runs shard_mapped
     over tp (see ``paged_decode_attention_pallas_tp``)."""
+    if isinstance(k_pages, QuantizedPages):
+        # The Pallas kernels stream raw pages; int8+scale dequantize is
+        # only wired into the XLA gather (the engine forces impl="xla"
+        # when kv_quantize is on — this is defense in depth).
+        impl = "xla"
     if impl.startswith("pallas"):
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             return paged_decode_attention_pallas_tp(
@@ -222,7 +285,23 @@ def write_pages(
     layer: jax.Array | None = None,  # [] int32 when pages carry a layer axis
 ) -> jax.Array:
     """Single-array page scatter (``write_kv_pages`` for one side; the MLA
-    latent cache writes only one array per token)."""
+    latent cache writes only one array per token).
+
+    ``QuantizedPages`` targets quantize the fresh rows on write (absmax
+    over the head dim) and scatter values and scales with the same flat
+    indices, so the drop-sentinel/validity logic is shared."""
+    if isinstance(pages, QuantizedPages):
+        q_new, s_new = quantize_kv_rows(new)
+        return QuantizedPages(
+            write_pages(
+                pages.q, q_new, page_table, start,
+                valid_len=valid_len, layer=layer,
+            ),
+            _write_scale_pages(
+                pages.scale, s_new, page_table, start,
+                valid_len=valid_len, layer=layer,
+            ),
+        )
     if pages.ndim == 5:
         L, N, P, K, D = pages.shape
         total = L * N
@@ -232,7 +311,32 @@ def write_pages(
         total = N
         base = 0
     B, S = new.shape[:2]
-    oob = total * P  # drop sentinel: one past the last flat slot
+    flat = _flat_slot_indices(
+        page_table, start, S, P, base, total, valid_len
+    ).reshape(B * S)
+    shape = pages.shape
+    pf = pages.reshape(total * P, K, D)
+    pf = pf.at[flat].set(new.reshape(B * S, K, D), mode="drop")
+    return pf.reshape(shape)
+
+
+def _flat_slot_indices(
+    page_table: jax.Array,  # [B, MaxP] int32 page indices (-1 = unassigned)
+    start: jax.Array,       # [B] int32 write offsets
+    S: int,                 # tokens per row being written
+    P: int,                 # page size
+    base,                   # layer * N flat-page offset (0 without layers)
+    total: int,             # total flat pages
+    valid_len: jax.Array | None,
+) -> jax.Array:
+    """[B, S] flat cache-slot index per written token, shared by the value
+    and scale planes so the drop-sentinel/validity logic cannot diverge.
+    Token t of row b lands at ``(page_table[b, (start+t)//P] + base) * P +
+    (start+t) % P``; unassigned (-1) pages and tokens past ``valid_len``
+    get ``total * P`` — one past the end, dropped by the scatter (negative
+    indices would WRAP under JAX indexing semantics, so the sentinel is
+    past-the-end)."""
+    oob = total * P
     pos = start[:, None] + jnp.arange(S)[None, :]          # [B, S]
     page_idx = jnp.take_along_axis(
         page_table, jnp.clip(pos // P, 0, page_table.shape[1] - 1), axis=1
@@ -240,14 +344,75 @@ def write_pages(
     flat = (page_idx + base) * P + pos % P                  # [B, S]
     if valid_len is not None:
         ok = jnp.arange(S)[None, :] < valid_len[:, None]
-        flat = jnp.where(ok & (page_idx >= 0), flat, oob)
+        return jnp.where(ok & (page_idx >= 0), flat, oob)
+    return jnp.where(page_idx >= 0, flat, oob)
+
+
+def _write_scale_pages(
+    pages: jax.Array,       # [N, P, K] — or [L, N, P, K] with layer
+    new: jax.Array,         # [B, S, K] per-token scales
+    page_table: jax.Array,
+    start: jax.Array,
+    valid_len: jax.Array | None = None,
+    layer: jax.Array | None = None,
+) -> jax.Array:
+    """``write_pages`` for the scale planes of ``QuantizedPages`` (same
+    flat slot math via ``_flat_slot_indices``, one fewer axis)."""
+    if pages.ndim == 4:
+        L, N, P, K = pages.shape
+        total = L * N
+        base = (layer if layer is not None else 0) * N
     else:
-        flat = jnp.where(page_idx >= 0, flat, oob)
-    flat = flat.reshape(B * S)
+        N, P, K = pages.shape
+        total = N
+        base = 0
+    B, S = new.shape[:2]
+    flat = _flat_slot_indices(
+        page_table, start, S, P, base, total, valid_len
+    ).reshape(B * S)
     shape = pages.shape
-    pf = pages.reshape(total * P, K, D)
-    pf = pf.at[flat].set(new.reshape(B * S, K, D), mode="drop")
+    pf = pages.reshape(total * P, K)
+    pf = pf.at[flat].set(new.reshape(B * S, K), mode="drop")
     return pf.reshape(shape)
+
+
+def _gather_kv(
+    k_pages, v_pages, page_table: jax.Array, layer, dtype
+) -> tuple[jax.Array, jax.Array]:
+    """Shared page gather for the XLA readers: [B, MaxP] table ->
+    contiguous ([B, L, K, D], [B, L, K, D]) sequence views, L = MaxP * P.
+    Handles the optional leading layer axis (flatten + ``layer * N``
+    offset) and ``QuantizedPages`` (gather int8 values + scales, then
+    dequantize — XLA fuses the convert/multiply into the consuming
+    einsum's operand read)."""
+    k_scale = v_scale = None
+    if isinstance(k_pages, QuantizedPages):
+        k_pages, k_scale = k_pages.q, k_pages.scale
+        v_pages, v_scale = v_pages.q, v_pages.scale
+    if k_pages.ndim == 5:
+        Lr, N, P, K, D = k_pages.shape
+        base = (layer if layer is not None else 0) * N
+        k_pages = k_pages.reshape(Lr * N, P, K, D)
+        v_pages = v_pages.reshape(Lr * N, P, K, D)
+        if k_scale is not None:
+            k_scale = k_scale.reshape(Lr * N, P, K)
+            v_scale = v_scale.reshape(Lr * N, P, K)
+        nmax = Lr * N - 1
+    else:
+        N, P, K, D = k_pages.shape
+        base = 0
+        nmax = N - 1
+    B = page_table.shape[0]
+    L = page_table.shape[1] * P
+    safe_table = jnp.clip(page_table + base, 0, nmax)
+    k_seq = k_pages[safe_table].reshape(B, L, K, D)
+    v_seq = v_pages[safe_table].reshape(B, L, K, D)
+    if k_scale is not None:
+        ks = k_scale[safe_table].reshape(B, L, K)
+        vs = v_scale[safe_table].reshape(B, L, K)
+        k_seq = _dequantize_gathered(k_seq, ks, dtype)
+        v_seq = _dequantize_gathered(v_seq, vs, dtype)
+    return k_seq, v_seq
 
 
 def paged_prefix_attention(
@@ -266,24 +431,12 @@ def paged_prefix_attention(
     every cached position t <= start + s. Gather-based XLA reference (the
     Pallas flash variant can come later — admission is not the steady-state
     hot loop the way decode is)."""
-    if k_pages.ndim == 5:
-        Lr, N, P, K, D = k_pages.shape
-        base = (layer if layer is not None else 0) * N
-        k_pages = k_pages.reshape(Lr * N, P, K, D)
-        v_pages = v_pages.reshape(Lr * N, P, K, D)
-        nmax = Lr * N - 1
-    else:
-        N, P, K, D = k_pages.shape
-        base = 0
-        nmax = N - 1
+    k_seq, v_seq = _gather_kv(k_pages, v_pages, page_table, layer, q.dtype)
     B, S, H, _ = q.shape
+    K, D = k_seq.shape[-2:]
     G = H // K
-    MaxP = page_table.shape[1]
-    L = MaxP * P
+    L = k_seq.shape[1]
     scale = 1.0 / (D ** 0.5)
-    safe_table = jnp.clip(page_table + base, 0, nmax)
-    k_seq = k_pages[safe_table].reshape(B, L, K, D)
-    v_seq = v_pages[safe_table].reshape(B, L, K, D)
     qg = q.reshape(B, S, K, G, D)
     scores = jnp.einsum(
         "bskgd,btkd->bkgst", qg, k_seq, preferred_element_type=jnp.float32
@@ -316,26 +469,12 @@ def paged_decode_attention(
     masks positions >= length. The Pallas kernel avoids this materialized
     gather; results must match to ~1e-2 in bf16 / 1e-5 in f32.
     """
-    if k_pages.ndim == 5:
-        Lr, N, P, K, D = k_pages.shape
-        base = (layer if layer is not None else 0) * N
-        k_pages = k_pages.reshape(Lr * N, P, K, D)
-        v_pages = v_pages.reshape(Lr * N, P, K, D)
-        nmax = Lr * N - 1
-    else:
-        N, P, K, D = k_pages.shape
-        base = 0
-        nmax = N - 1
+    k_seq, v_seq = _gather_kv(k_pages, v_pages, page_table, layer, q.dtype)
     B, H, _ = q.shape
+    K, D = k_seq.shape[-2:]
     G = H // K
-    MaxP = page_table.shape[1]
+    L = k_seq.shape[1]
     scale = 1.0 / (D ** 0.5)
-    safe_table = jnp.clip(page_table + base, 0, nmax)
-    k_seq = k_pages[safe_table]                    # [B, MaxP, P, K, D]
-    v_seq = v_pages[safe_table]
-    L = MaxP * P
-    k_seq = k_seq.reshape(B, L, K, D)
-    v_seq = v_seq.reshape(B, L, K, D)
     qg = q.reshape(B, K, G, D)
     scores = jnp.einsum(
         "bkgd,blkd->bkgl", qg, k_seq, preferred_element_type=jnp.float32

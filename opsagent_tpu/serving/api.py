@@ -730,6 +730,7 @@ def run_engine_server(
     ep: int = 1,
     max_batch_size: int = 8,
     quantize: str = "",
+    kv_quantize: str = "",
     speculative_k: int = 0,
 ) -> None:
     from aiohttp import web
@@ -754,6 +755,7 @@ def run_engine_server(
         ep=ep,
         max_batch_size=max_batch_size,
         quantize=quantize,
+        kv_quantize=kv_quantize,
         speculative_k=speculative_k,
         # Production server: compile everything before accepting requests
         # so no client ever pays XLA compile inside its TTFT.

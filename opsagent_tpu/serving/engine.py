@@ -17,6 +17,7 @@ points (SURVEY.md section 7):
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from dataclasses import dataclass, field
@@ -158,6 +159,13 @@ class EngineConfig:
     # bottleneck — and the footprint: Llama-3-8B fits a 16 GB v5e chip
     # only at int8.
     quantize: str = ""
+    # KV-cache quantization: "" (pages in compute dtype) or "int8" (pages
+    # int8 + per-token-per-head f32 scales, ops.attention.QuantizedPages).
+    # Halves decode-step KV reads — the dominant non-weight HBM term at
+    # serving shapes (PERF.md roofline: ~4 GB/step at the 8B bench
+    # config). Forces the xla paged-attention backend (the Pallas kernels
+    # stream raw pages); unsupported for MLA latent caches.
+    kv_quantize: str = ""
     # Compile every serving program (all prefill buckets + decode) at
     # construction time so the first real request never pays XLA compile
     # (the TTFT budget is 500 ms; a cold bucket compile is tens of seconds).
@@ -250,7 +258,26 @@ class Engine:
             tp -= 1
         self.mesh = make_mesh(tp=tp, dp=cfg.dp, sp=cfg.sp, ep=cfg.ep)
         self.lock = threading.RLock()
+        # Re-entrancy guard for mesh_ctx (per-thread): the jit cache keys
+        # on the mesh-context STACK, so `with mesh:` nested inside another
+        # `with mesh:` compiles a separate program from a single-level
+        # entry with an identical signature (measured r04: warmup-compiled
+        # sampler programs were recompiled inside the serving window).
+        # Every engine jit call enters the mesh through mesh_ctx so the
+        # ambient depth is exactly one, no matter how call paths compose.
+        self._mesh_tls = threading.local()
 
+        if cfg.kv_quantize and cfg.kv_quantize != "int8":
+            raise ValueError(
+                f"kv_quantize={cfg.kv_quantize!r}: only 'int8' is supported"
+            )
+        if cfg.kv_quantize and self.model_cfg.mla is not None:
+            # MLA's latent cache feeds weight-absorbed matmuls (quantizing
+            # the shared latent is a different fidelity question), and the
+            # materialized layout packs mixed-width k/v planes; neither is
+            # validated under int8 pages — reject rather than silently
+            # degrade a V3-class deployment.
+            raise ValueError("kv_quantize is not supported for MLA models")
         if cfg.quantize and cfg.quantize not in ("int8", "int4"):
             raise ValueError(
                 f"quantize={cfg.quantize!r}: supported values are "
@@ -315,9 +342,14 @@ class Engine:
                     )
         self.params = shard_params(params, specs, self.mesh)
         cache = llama.make_cache(
-            self.model_cfg, cfg.num_pages, cfg.page_size, dtype=cfg.dtype
+            self.model_cfg, cfg.num_pages, cfg.page_size, dtype=cfg.dtype,
+            kv_quantize=cfg.kv_quantize,
         )
-        self.cache = shard_params(cache, llama.cache_specs(self.model_cfg), self.mesh)
+        self.cache = shard_params(
+            cache,
+            llama.cache_specs(self.model_cfg, kv_quantize=cfg.kv_quantize),
+            self.mesh,
+        )
         self.alloc = PageAllocator(
             cfg.num_pages, cfg.page_size, cfg.max_pages_per_seq,
             prefix_cache=cfg.prefix_cache,
@@ -336,6 +368,13 @@ class Engine:
             log.info(
                 "mla model: forcing xla paged attention (was %s)",
                 self.attn_impl,
+            )
+            self.attn_impl = "xla"
+        if cfg.kv_quantize and self.attn_impl != "xla":
+            # int8 pages + scales only flow through the XLA gather reader.
+            log.info(
+                "kv_quantize=%s: forcing xla paged attention (was %s)",
+                cfg.kv_quantize, self.attn_impl,
             )
             self.attn_impl = "xla"
         if (
@@ -541,6 +580,23 @@ class Engine:
         }),
     }
 
+    @contextlib.contextmanager
+    def mesh_ctx(self):
+        """Enter ``self.mesh`` at depth exactly one per thread: nested
+        entries are no-ops. The jit cache keys on the mesh-context stack,
+        so a nested `with mesh:` silently recompiles programs an outer
+        single-level entry already compiled (see __init__'s _mesh_tls
+        note)."""
+        if getattr(self._mesh_tls, "active", False):
+            yield
+            return
+        self._mesh_tls.active = True
+        try:
+            with self.mesh:
+                yield
+        finally:
+            self._mesh_tls.active = False
+
     def warmup(self, level: str = "full") -> float:
         """Compile serving programs ahead of the first request: each
         prefill bucket (plain + prefix form), the pipelined decode block
@@ -561,7 +617,7 @@ class Engine:
         t0 = time.perf_counter()
         B = self.cfg.max_batch_size
         MaxP = self.cfg.max_pages_per_seq
-        with self.lock, self.mesh:
+        with self.lock, self.mesh_ctx():
             # Re-warming a LIVE engine: settle in-flight decode state first,
             # exactly like the legacy step path (warmup's throwaway carries
             # would otherwise desync lanes still referenced by pulls).
@@ -857,7 +913,7 @@ class Engine:
                     tables[i] = self.alloc.page_table_row(sid)
                 dev_out: list = []
                 with annotate("engine.prefill_chunk"), \
-                        device_timer("prefill_chunk", dev_out), self.mesh:
+                        device_timer("prefill_chunk", dev_out), self.mesh_ctx():
                     logits, self.cache = self._prefill_prefix_jit(
                         self.params,
                         jnp.asarray(tokens),
@@ -891,10 +947,20 @@ class Engine:
                 finished_rows = [i for i in finished_rows if i not in bad]
                 first_toks = None
                 if finished_rows:
-                    first_toks = self._sample_one(
-                        logits[jnp.asarray(finished_rows)],
-                        [seqs[i] for i in finished_rows],
-                    )
+                    # Sample the FULL padded batch and index on host: a
+                    # device gather of `finished_rows` would specialize
+                    # sample/gather programs on every distinct finished
+                    # count (r04 on-chip: dozens of tiny compiles at ~1 s
+                    # each over the tunneled remote-compile, all inside
+                    # the serving window). Bp is already the program's
+                    # padded row bucket; padding rows sample greedily
+                    # into a discarded slot.
+                    fset = set(finished_rows)
+                    row_seqs: list[Any] = [
+                        seqs[i] if i in fset else None
+                        for i in range(len(seq_ids))
+                    ] + [None] * (Bp - len(seq_ids))
+                    first_toks = self._sample_one(logits, row_seqs)
                 for i, (sid, seq, d, c) in enumerate(
                     zip(seq_ids, seqs, dones, chunks)
                 ):
@@ -907,7 +973,7 @@ class Engine:
                         out[sid] = False
                         continue
                     del self._prefilling[sid]
-                    token = int(first_toks[finished_rows.index(i)])
+                    token = int(first_toks[i])
                     seq.ttft_s = time.perf_counter() - seq.started_s
                     perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
                     try:
@@ -955,7 +1021,7 @@ class Engine:
                 tokens[0, :chunk] = seq.prompt_ids[done:done + chunk]
                 dev_out: list = []
                 with annotate("engine.prefill_chunk"), \
-                        device_timer("prefill_chunk", dev_out), self.mesh:
+                        device_timer("prefill_chunk", dev_out), self.mesh_ctx():
                     if done:
                         logits, self.cache = self._prefill_prefix_jit(
                             self.params,
@@ -1091,15 +1157,22 @@ class Engine:
         bias = self._bias_array(seqs, B)
         if bias is not None:
             logits = logits + jnp.asarray(bias)
-        self._sample_key, sub = jax.random.split(self._sample_key)
-        tok = self._sample_jit(
-            logits,
-            sub,
-            jnp.asarray(temps),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-            None if mask is None else jnp.asarray(mask),
-        )
+        # Under the mesh context ALWAYS: the jit cache keys on the ambient
+        # mesh, so a call outside `with self.mesh:` recompiles the sampler
+        # (and the eager random.split helpers) with an identical signature
+        # (r04: warmed sample programs were recompiled inside the serving
+        # window because prefill_batch sampled outside the mesh block
+        # warmup used).
+        with self.mesh_ctx():
+            self._sample_key, sub = jax.random.split(self._sample_key)
+            tok = self._sample_jit(
+                logits,
+                sub,
+                jnp.asarray(temps),
+                jnp.asarray(top_k),
+                jnp.asarray(top_p),
+                None if mask is None else jnp.asarray(mask),
+            )
         toks = np.asarray(tok)
         if any(s is not None and s.params.logprobs for s in seqs):
             # First-token logprobs (prefill's sampled token), host-side:
@@ -1349,10 +1422,12 @@ class Engine:
             slots = running + [None] * (B - len(running))
             temps, top_k, top_p, mask = self._sampling_arrays(slots, B)
             bias = self._bias_array(slots, B)
-            self._sample_key, sub = jax.random.split(self._sample_key)
             want_lp = any(s.params.logprobs for s in running)
             chosen_lp = top_ids = top_lps = None
-            with self.mesh:
+            with self.mesh_ctx():
+                # split under the mesh like warmup's, or its eager helper
+                # programs recompile on the first serving-window call.
+                self._sample_key, sub = jax.random.split(self._sample_key)
                 args = (
                     self.params,
                     jnp.asarray(tokens),
@@ -1622,18 +1697,24 @@ class Engine:
             ):
                 fsm_obj = None
             if self._carry is None:
-                # Fork the decode-loop PRNG stream off the admission stream
-                # so per-step sampling never reuses an admission key.
-                self._sample_key, carry_key = jax.random.split(self._sample_key)
-                # Distinct arrays: the donated args must be distinct
-                # buffers (donating the same one twice is an error).
-                self._carry = (
-                    jnp.zeros((B,), jnp.int32),
-                    jnp.zeros((B,), jnp.int32),
-                    jnp.zeros((B,), bool),
-                    jnp.zeros((B,), jnp.int32),  # device FSM states (0=free)
-                    carry_key,
-                )
+                # Under mesh_ctx like every other eager helper: the zeros/
+                # split programs recompile per mesh-context depth otherwise.
+                with self.mesh_ctx():
+                    # Fork the decode-loop PRNG stream off the admission
+                    # stream so per-step sampling never reuses an
+                    # admission key.
+                    self._sample_key, carry_key = jax.random.split(
+                        self._sample_key
+                    )
+                    # Distinct arrays: the donated args must be distinct
+                    # buffers (donating the same one twice is an error).
+                    self._carry = (
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B,), bool),
+                        jnp.zeros((B,), jnp.int32),  # device FSM (0=free)
+                        carry_key,
+                    )
             c_tok, c_at, c_eos, c_fsm, c_key = self._carry
             perf = get_perf_stats()
             t_disp = time.perf_counter()
@@ -1671,7 +1752,7 @@ class Engine:
                     ov_hist_dev = self._ov_hist_zeros
             dev_out: list = []
             with annotate("engine.decode_block"), \
-                    device_timer("decode_block", dev_out), self.mesh:
+                    device_timer("decode_block", dev_out), self.mesh_ctx():
                 if speculate:
                     toks, counts, self.cache, carry = (
                         self._spec_pipeline_jit(

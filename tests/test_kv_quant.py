@@ -1,0 +1,201 @@
+"""int8 KV-cache quantization (ops.attention.QuantizedPages).
+
+Decode-step KV reads are the dominant non-weight HBM term at serving
+shapes (PERF.md roofline); int8 pages + per-token-per-head scales halve
+them. These tests pin the write/read roundtrip against the bf16 page
+path and the engine-level wiring (config validation, backend forcing,
+end-to-end generation).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.ops.attention import (
+    QuantizedPages,
+    paged_decode_attention,
+    paged_prefix_attention,
+    quantize_kv_rows,
+    write_kv_pages,
+)
+
+
+def _rand_case(rng, B=2, S=12, K=2, D=16, P=4, MaxP=6, num_pages=16):
+    q = jnp.asarray(rng.standard_normal((B, S, K * 2, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+    table = np.full((B, MaxP), -1, np.int32)
+    used = 0
+    for b in range(B):
+        for p in range((S + P - 1) // P):
+            table[b, p] = used
+            used += 1
+    return q, k, v, jnp.asarray(table)
+
+
+def _pages(num_pages, P, K, D, quant):
+    if quant:
+        return QuantizedPages(
+            jnp.zeros((num_pages, P, K, D), jnp.int8),
+            jnp.ones((num_pages, P, K), jnp.float32),
+        )
+    return jnp.zeros((num_pages, P, K, D), jnp.float32)
+
+
+def test_quantize_kv_rows_roundtrip_error():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 5, 3, 16)), jnp.float32)
+    qv, sc = quantize_kv_rows(x)
+    assert qv.dtype == jnp.int8 and sc.shape == (2, 5, 3)
+    err = np.abs(np.asarray(qv, np.float32) * np.asarray(sc)[..., None] - np.asarray(x))
+    # Symmetric absmax int8: error bounded by half a step per row.
+    assert (err <= np.asarray(sc)[..., None] / 2 + 1e-6).all()
+
+
+@pytest.mark.parametrize("reader", ["decode", "prefix"])
+def test_quantized_pages_attention_matches_fp(reader):
+    """write -> gather-attend through QuantizedPages must match the bf16
+    page path to int8-rounding tolerance."""
+    rng = np.random.default_rng(1)
+    B, S, K, D, P, MaxP, N = 2, 12, 2, 16, 4, 6, 16
+    q, k, v, table = _rand_case(rng, B, S, K, D, P, MaxP, N)
+    start = jnp.zeros((B,), jnp.int32)
+    lens = jnp.full((B,), S, jnp.int32)
+
+    kf, vf = write_kv_pages(
+        _pages(N, P, K, D, False), _pages(N, P, K, D, False),
+        k, v, table, start, valid_len=lens,
+    )
+    kq, vq = write_kv_pages(
+        _pages(N, P, K, D, True), _pages(N, P, K, D, True),
+        k, v, table, start, valid_len=lens,
+    )
+    assert isinstance(kq, QuantizedPages)
+    if reader == "decode":
+        q1 = q[:, -1]
+        ref = paged_decode_attention(q1, kf, vf, table, lens)
+        got = paged_decode_attention(q1, kq, vq, table, lens)
+    else:
+        ref = paged_prefix_attention(q, kf, vf, table, start, lens)
+        got = paged_prefix_attention(q, kq, vq, table, start, lens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_quantized_pages_layer_form_and_chunked_writes():
+    """The [L, N, P, K(, D)] layer form: chunked writes at an offset land
+    in the right layer's region and read back through the decode path."""
+    rng = np.random.default_rng(2)
+    B, S, K, D, P, MaxP, N, L = 1, 8, 2, 8, 4, 4, 8, 2
+    q, k, v, table = _rand_case(rng, B, S, K, D, P, MaxP, N)
+    lens = jnp.full((B,), S, jnp.int32)
+
+    def layered(quant):
+        if quant:
+            return QuantizedPages(
+                jnp.zeros((L, N, P, K, D), jnp.int8),
+                jnp.ones((L, N, P, K), jnp.float32),
+            )
+        return jnp.zeros((L, N, P, K, D), jnp.float32)
+
+    for li in range(L):
+        kf, vf = layered(False), layered(False)
+        kq, vq = layered(True), layered(True)
+        # Two chunked writes: [0, S/2) then [S/2, S).
+        h = S // 2
+        for lo, hi in ((0, h), (h, S)):
+            seg_k, seg_v = k[:, lo:hi], v[:, lo:hi]
+            st = jnp.full((B,), lo, jnp.int32)
+            vl = jnp.full((B,), hi - lo, jnp.int32)
+            kf, vf = write_kv_pages(
+                kf, vf, seg_k, seg_v, table, st,
+                valid_len=vl, layer=jnp.int32(li),
+            )
+            kq, vq = write_kv_pages(
+                kq, vq, seg_k, seg_v, table, st,
+                valid_len=vl, layer=jnp.int32(li),
+            )
+        ref = paged_decode_attention(
+            q[:, -1], kf, vf, table, lens, layer=jnp.int32(li)
+        )
+        got = paged_decode_attention(
+            q[:, -1], kq, vq, table, lens, layer=jnp.int32(li)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=5e-2, atol=5e-2
+        )
+
+
+# -- engine wiring -----------------------------------------------------------
+
+def _engine_kwargs():
+    return dict(
+        model="tiny-test", max_batch_size=2, num_pages=32, page_size=8,
+        max_pages_per_seq=8, prefill_buckets=(16,), decode_block=4,
+    )
+
+
+def test_engine_kv_quantize_generates():
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    eng = Engine(EngineConfig(kv_quantize="int8", **_engine_kwargs()))
+    assert eng.attn_impl == "xla"
+    sid = eng.begin_request(
+        [5, 6, 7, 8], SamplingParams(max_tokens=6, temperature=0.0)
+    )
+    while not eng.sequences[sid].done:
+        eng.step_block([sid])
+    toks = eng.finish(sid)
+    assert len(toks) == 6 and all(0 <= t < 512 for t in toks)
+
+
+def test_engine_kv_quantize_greedy_matches_fp_cache():
+    """tiny-test at f32: int8 KV rounding must not change greedy tokens
+    on a short generation (near-lossless is the bar that makes the
+    default flippable)."""
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    prompt = [11, 12, 13, 14, 15]
+    outs = []
+    for kvq in ("", "int8"):
+        eng = Engine(EngineConfig(kv_quantize=kvq, **_engine_kwargs()))
+        sid = eng.begin_request(
+            prompt, SamplingParams(max_tokens=8, temperature=0.0)
+        )
+        while not eng.sequences[sid].done:
+            eng.step_block([sid])
+        outs.append(eng.finish(sid))
+    assert outs[0] == outs[1]
+
+
+def test_engine_rejects_bad_kv_quantize_and_mla_combo():
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    with pytest.raises(ValueError, match="kv_quantize"):
+        Engine(EngineConfig(kv_quantize="int4", **_engine_kwargs()))
+    kwargs = dict(_engine_kwargs(), model="tiny-mla")
+    with pytest.raises(ValueError, match="MLA"):
+        Engine(EngineConfig(kv_quantize="int8", **kwargs))
+
+
+def test_engine_kv_quantize_under_tp_mesh():
+    """Quantized pages (values AND scales) must shard over tp and execute."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    eng = Engine(EngineConfig(
+        tp=2, kv_quantize="int8", **_engine_kwargs()
+    ))
+    sid = eng.begin_request(
+        [3, 4, 5], SamplingParams(max_tokens=4, temperature=0.0)
+    )
+    while not eng.sequences[sid].done:
+        eng.step_block([sid])
+    assert len(eng.finish(sid)) == 4
