@@ -8,7 +8,7 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to eight stages in isolated
+A plain `python bench.py` orchestrates up to nine stages in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
 the guaranteed number), then the bench-8b int8 headline, its int4,

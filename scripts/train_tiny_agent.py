@@ -304,6 +304,12 @@ def main() -> int:
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the non-gating held-out-phrasing probes "
                          "(each burns a full agent episode; CI uses this)")
+    ap.add_argument("--kv-quantize", default="", choices=("", "int8"),
+                    help="after the plain serving run passes, re-serve "
+                         "the SAME checkpoint with the int8 KV cache and "
+                         "re-run every memorized-agent assertion: greedy "
+                         "faithfulness under KV quantization on learned "
+                         "weights for one extra serving pass")
     ap.add_argument("--wide", action="store_true",
                     help="4x the model (d=128, f=256, 8 heads): the "
                          "capacity experiment for held-out phrasing "
@@ -387,11 +393,20 @@ def main() -> int:
     if args.skip_agent:
         return 0
     ok = run_agent(ckpt, tok_path, cfg, tasks, probe=not args.no_probe)
+    if ok and args.kv_quantize:
+        # Same checkpoint, int8 KV cache: the memorized assertions rerun
+        # unchanged, proving greedy faithfulness under KV quantization on
+        # LEARNED weights at the cost of one extra serving pass (training
+        # is the expensive part and happens once).
+        print("re-serving with kv_quantize=" + args.kv_quantize,
+              file=sys.stderr)
+        ok = run_agent(ckpt, tok_path, cfg, tasks, probe=False,
+                       kv_quantize=args.kv_quantize)
     return 0 if ok else 1
 
 
 def run_agent(ckpt: str, tok_path: str, cfg, tasks=None,
-              probe: bool = True) -> bool:
+              probe: bool = True, kv_quantize: str = "") -> bool:
     """Serve the trained checkpoint and run the real agent loop on EVERY
     task's instruction, asserting each memorized final answer."""
     from opsagent_tpu.agent.react import assistant_with_config
@@ -420,6 +435,7 @@ def run_agent(ckpt: str, tok_path: str, cfg, tasks=None,
             max_pages_per_seq=64,
             max_batch_size=2,
             prefill_buckets=(128, 512, 1024),
+            kv_quantize=kv_quantize,
         ),
         model_cfg=cfg,
     )

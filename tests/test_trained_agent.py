@@ -29,12 +29,18 @@ def test_train_serve_agent_roundtrip(tmp_path):
             sys.executable, "-u",
             os.path.join(REPO, "scripts", "train_tiny_agent.py"),
             "--steps", "600",
+            # One extra SERVING pass (training happens once): the same
+            # checkpoint re-served with the int8 KV cache must reproduce
+            # every memorized assertion — greedy faithfulness under KV
+            # quantization on LEARNED weights, not random ones.
+            "--kv-quantize", "int8",
             "--out", str(tmp_path / "ckpt"),
         ],
         capture_output=True, text=True, timeout=1200, env=env, cwd=REPO,
     )
     assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
     assert "agent PASSED" in out.stdout
+    assert "re-serving with kv_quantize=int8" in out.stderr
     assert (tmp_path / "ckpt" / "model.safetensors").exists()
 
 
