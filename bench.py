@@ -374,13 +374,33 @@ def run_single() -> None:
         # of it (its warmup level does not compile the spec program).
         spec_k = 0
     kv_quantize = os.environ.get("OPSAGENT_BENCH_KV", "")
+    # Page geometry, overridable for on-chip sweeps: the XLA gather reads
+    # the FULL page-table capacity (max_pages x page_size) per step
+    # regardless of resident tokens, so capacity directly scales the
+    # KV-read term the roofline blames; the Pallas kernels read only
+    # resident pages. OPSAGENT_BENCH_PAGE/OPSAGENT_BENCH_MAXPAGES let a
+    # sweep probe that tradeoff without code edits.
+    page_size = int(os.environ.get("OPSAGENT_BENCH_PAGE", "64"))
+    max_pages = int(os.environ.get("OPSAGENT_BENCH_MAXPAGES", "12"))
+    # Fail fast on undersized sweep points: OutOfPages mid-window would
+    # force-finish sequences ('length') and quietly deflate the metric.
+    # Lookahead slack: decode_block x (pipeline_depth + 1) pre-booked
+    # tokens (EngineConfig defaults 32 x 3).
+    need = prompt_len + steps + 96
+    if page_size * max_pages < need:
+        raise SystemExit(
+            f"bench: page geometry {page_size}x{max_pages} holds "
+            f"{page_size * max_pages} tokens < {need} needed "
+            f"(prompt {prompt_len} + steps {steps} + lookahead 96); "
+            f"raise OPSAGENT_BENCH_MAXPAGES or lower OPSAGENT_BENCH_STEPS"
+        )
     cfg = EngineConfig(
         model=model,
         dtype=dtype,
         max_batch_size=batch,
-        num_pages=max(512, batch * 12),
-        page_size=64,
-        max_pages_per_seq=12,
+        num_pages=max(512 * 64 // page_size, batch * max_pages),
+        page_size=page_size,
+        max_pages_per_seq=max_pages,
         prefill_buckets=(prompt_len,),
         quantize=quantize,
         kv_quantize=kv_quantize,

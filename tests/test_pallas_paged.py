@@ -251,6 +251,33 @@ def test_pallas_dma_layer_form():
         )
 
 
+@pytest.mark.slow
+def test_pallas_dma_at_bench_8b_decode_shape():
+    """Interpret-mode parity at the EXACT bench-8b decode shape (B=32,
+    K=8, D=128, P=64, MaxP=12, bf16 pages, ragged lengths): the shape the
+    on-chip kernel sweep runs, validated before burning chip time on it.
+    Reduced batch rows would hide grid/scratch sizing mistakes that only
+    appear at the serving shape."""
+    rng = np.random.default_rng(42)
+    B, H, K, D, P, MaxP = 32, 32, 8, 128, 64, 12
+    lengths = [int(rng.integers(1, MaxP * P + 1)) for _ in range(B)]
+    lengths[0] = MaxP * P  # pin the exactly-full boundary the bench reaches
+    q, k_pages, v_pages, table, lens = _make_case(
+        rng, B, H, K, D, P, MaxP, num_pages=B * MaxP + 2, lengths=lengths
+    )
+    q = q.astype(jnp.bfloat16)
+    k_pages = k_pages.astype(jnp.bfloat16)
+    v_pages = v_pages.astype(jnp.bfloat16)
+    ref = paged_decode_attention(q, k_pages, v_pages, table, lens)
+    got = paged_decode_attention_pallas_dma(
+        q, k_pages, v_pages, table, lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
 def test_pallas_dma_rejects_unaligned_head_dim():
     """Compiled mode refuses head_dim % 128 != 0 up front (Mosaic's
     manual-DMA slices must be 128-aligned on the minormost dim; r04
