@@ -158,10 +158,18 @@ def paged_decode_attention_pallas_tp(
     kernel = _pallas_kernel_fn(impl)
 
     spec_q = P(None, "tp", None)
+    five_d = k_pages.ndim == 5
     spec_kv = (
-        P(None, None, None, "tp", None) if k_pages.ndim == 5
+        P(None, None, None, "tp", None) if five_d
         else P(None, None, "tp", None)
     )
+    if isinstance(k_pages, QuantizedPages):
+        # Scale planes shard with their values' kv-head axis (one fewer
+        # trailing dim); the spec pytree mirrors the QuantizedPages leaf.
+        spec_sc = (
+            P(None, None, None, "tp") if five_d else P(None, None, "tp")
+        )
+        spec_kv = QuantizedPages(spec_kv, spec_sc)
     if layer is None:
         layer = jnp.int32(0)
 
@@ -190,10 +198,10 @@ def paged_decode_attention_auto(
     ``paged_attention_backend``, resolved at trace time by the caller).
     With a mesh whose tp axis is >1, the Pallas path runs shard_mapped
     over tp (see ``paged_decode_attention_pallas_tp``)."""
-    if isinstance(k_pages, QuantizedPages):
-        # The Pallas kernels stream raw pages; int8+scale dequantize is
-        # only wired into the XLA gather (the engine forces impl="xla"
-        # when kv_quantize is on — this is defense in depth).
+    if isinstance(k_pages, QuantizedPages) and impl != "pallas-dma":
+        # int8+scale pages flow through the XLA gather or the manual-DMA
+        # kernel (which streams int8 pages and dequantizes in VMEM); the
+        # (B, MaxP) grid kernel has no scale path.
         impl = "xla"
     if impl.startswith("pallas"):
         if mesh is not None and mesh.shape.get("tp", 1) > 1:

@@ -139,23 +139,12 @@ def _kernel_dma(
     table_ref,     # [B, MaxP] int32 page indices (-1 = unassigned)
     lengths_ref,   # [B] int32 tokens in cache (incl. the one being written)
     base_ref,      # [1] int32 flat-page offset (layer * N; 0 without layers)
-    # blocks
-    q_ref,         # [1, H, D] VMEM
-    k_hbm,         # [Ntot, P, K, D] ANY (stays in HBM; pages DMA'd manually)
-    v_hbm,         # [Ntot, P, K, D] ANY
-    o_ref,         # [1, H, D] VMEM
-    # scratch
-    k_buf,         # [2, P, K, D] VMEM — double-buffered page slots
-    v_buf,         # [2, P, K, D] VMEM
-    k_sem,         # DMA semaphores (2,)
-    v_sem,
-    acc_ref,       # [H, D]  f32
-    m_ref,         # [H, 128] f32
-    l_ref,         # [H, 128] f32
-    *,
+    # blocks + scratch, order depending on ``quantized`` (see unpack below)
+    *refs,
     page_size: int,
     num_kv_heads: int,
     max_pages: int,
+    quantized: bool = False,
 ):
     """One grid step per SEQUENCE; its pages stream through two VMEM slots
     via manually double-buffered DMAs. Versus the (B, MaxP) grid kernel
@@ -163,7 +152,23 @@ def _kernel_dma(
     lose to the XLA gather at decode shapes (VERDICT r2 weak #3): the grid
     is B steps total, page DMAs are issued one ahead of compute, and pages
     past a sequence's length cost NOTHING (no step, no DMA) rather than a
-    clamped-index pipeline step."""
+    clamped-index pipeline step.
+
+    ``quantized``: pages are int8 and two extra VMEM blocks carry the
+    pre-gathered per-token-per-head f32 scales for THIS sequence
+    ([1, MaxP, P, K] each — the scale planes are 1/D of the page bytes,
+    so the caller's XLA gather of them is noise); each streamed page is
+    dequantized in VMEM right after its DMA completes. The scale planes
+    ride the automatic BlockSpec pipeline rather than manual DMAs
+    because their minormost dim (K, typically 8) cannot satisfy
+    Mosaic's 128-lane alignment rule for manual memref slices."""
+    if quantized:
+        (q_ref, k_hbm, v_hbm, k_sc_ref, v_sc_ref, o_ref,
+         k_buf, v_buf, k_sem, v_sem, acc_ref, m_ref, l_ref) = refs
+    else:
+        (q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, k_sem, v_sem, acc_ref, m_ref, l_ref) = refs
+        k_sc_ref = v_sc_ref = None
     b = pl.program_id(0)
     P = page_size
     K = num_kv_heads
@@ -211,8 +216,16 @@ def _kernel_dma(
         k_dma(slot, i).wait()
         v_dma(slot, i).wait()
 
-        kf = k_buf[slot].reshape(P * K, D)
-        vf = v_buf[slot].reshape(P * K, D)
+        kb = k_buf[slot]
+        vb = v_buf[slot]
+        if quantized:
+            # Dequantize the streamed int8 page in VMEM: [P, K] scales
+            # broadcast over the head dim. f32 keeps the dot exact; the
+            # attention FLOPs are trivial next to the HBM stream.
+            kb = kb.astype(jnp.float32) * k_sc_ref[0, i][..., None]
+            vb = vb.astype(jnp.float32) * v_sc_ref[0, i][..., None]
+        kf = kb.reshape(P * K, D)
+        vf = vb.reshape(P * K, D)
         s_full = jax.lax.dot_general(
             q, kf,
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -260,35 +273,68 @@ def paged_decode_attention_pallas_dma(
     Requires ``head_dim % 128 == 0``: Mosaic's manual-DMA memref slices
     must be 128-aligned on the minormost dim (r04 on-chip: head_dim=64
     fails to compile). Callers with smaller heads should use the grid
-    kernel or the xla gather (engine auto-falls-back)."""
+    kernel or the xla gather (engine auto-falls-back).
+
+    Accepts ``ops.attention.QuantizedPages`` (int8 values + per-token
+    scales): the int8 pages stream through the manual DMAs exactly like
+    bf16 ones (HALF the bytes), while THIS sequence's scale planes — 1/D
+    of the page bytes — are XLA-gathered outside and pipelined into VMEM
+    as ordinary blocks; dequantize happens in VMEM per streamed page.
+    This composes the kernel's read-only-resident-pages win with KV
+    quantization's bytes-per-token win."""
+    from .attention import QuantizedPages
+
     if q.shape[-1] % 128 != 0 and not interpret:
         raise ValueError(
             f"pallas-dma needs head_dim % 128 == 0, got {q.shape[-1]}; "
             f"use impl='pallas' or 'xla'"
         )
+    k_scale = v_scale = None
+    if isinstance(k_pages, QuantizedPages):
+        k_pages, k_scale = k_pages.q, k_pages.scale
+        v_pages, v_scale = v_pages.q, v_pages.scale
     if k_pages.ndim == 5:
         Lr, N, P, K, D = k_pages.shape
         k_pages = k_pages.reshape(Lr * N, P, K, D)
         v_pages = v_pages.reshape(Lr * N, P, K, D)
+        if k_scale is not None:
+            k_scale = k_scale.reshape(Lr * N, P, K)
+            v_scale = v_scale.reshape(Lr * N, P, K)
         base = (layer if layer is not None else 0) * N
+        nmax = Lr * N - 1
     else:
         N, P, K, D = k_pages.shape
         base = 0
+        nmax = N - 1
     B, H, _ = q.shape
     MaxP = page_table.shape[1]
     base_arr = jnp.full((1,), base, jnp.int32)
+    quantized = k_scale is not None
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, H, D), lambda b, t, ln, ba: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        # Per-sequence scale planes, gathered OUTSIDE the kernel (tiny:
+        # 4 bytes per D int8 values) and pipelined per grid step.
+        safe_table = jnp.clip(page_table + base, 0, nmax)
+        sc_spec = pl.BlockSpec(
+            (1, MaxP, P, K), lambda b, t, ln, ba: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale[safe_table], v_scale[safe_table]]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec(
-                (1, H, D), lambda b, t, ln, ba: (b, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, H, D), lambda b, t, ln, ba: (b, 0, 0),
             memory_space=pltpu.VMEM,
@@ -305,7 +351,8 @@ def paged_decode_attention_pallas_dma(
     )
     out = pl.pallas_call(
         functools.partial(
-            _kernel_dma, page_size=P, num_kv_heads=K, max_pages=MaxP
+            _kernel_dma, page_size=P, num_kv_heads=K, max_pages=MaxP,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
@@ -320,7 +367,7 @@ def paged_decode_attention_pallas_dma(
         ),
     )(
         page_table.astype(jnp.int32), lengths.astype(jnp.int32), base_arr,
-        q, k_pages, v_pages,
+        *operands,
     )
     return out
 

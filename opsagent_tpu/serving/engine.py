@@ -370,8 +370,10 @@ class Engine:
                 self.attn_impl,
             )
             self.attn_impl = "xla"
-        if cfg.kv_quantize and self.attn_impl != "xla":
-            # int8 pages + scales only flow through the XLA gather reader.
+        if cfg.kv_quantize and self.attn_impl not in ("xla", "pallas-dma"):
+            # int8 pages + scales flow through the XLA gather or the
+            # manual-DMA kernel (int8 streaming + VMEM dequantize); the
+            # grid kernel has no scale path.
             log.info(
                 "kv_quantize=%s: forcing xla paged attention (was %s)",
                 cfg.kv_quantize, self.attn_impl,

@@ -127,6 +127,99 @@ def test_quantized_pages_layer_form_and_chunked_writes():
         )
 
 
+# -- pallas-dma quantized kernel ---------------------------------------------
+
+def test_pallas_dma_quantized_matches_xla_reader():
+    """The manual-DMA kernel fed QuantizedPages (interpret mode) must
+    match the XLA gather reader on the same quantized cache — same
+    dequantize math, different data path."""
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(5)
+    B, S, K, D, P, MaxP, N = 2, 20, 2, 32, 4, 8, 16
+    q, k, v, table = _rand_case(rng, B, S, K, D, P, MaxP, N)
+    start = jnp.zeros((B,), jnp.int32)
+    lens = jnp.full((B,), S, jnp.int32)
+    kq, vq = write_kv_pages(
+        _pages(N, P, K, D, True), _pages(N, P, K, D, True),
+        k, v, table, start, valid_len=lens,
+    )
+    q1 = q[:, -1]
+    ref = paged_decode_attention(q1, kq, vq, table, lens)
+    got = paged_decode_attention_pallas_dma(
+        q1, kq, vq, table, lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_pallas_dma_quantized_layer_form():
+    """Whole-cache [L, N, ...] QuantizedPages with a layer offset through
+    the dma kernel (interpret) vs the XLA reader."""
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_pallas_dma,
+    )
+    from opsagent_tpu.ops.attention import QuantizedPages
+
+    rng = np.random.default_rng(6)
+    B, S, K, D, P, MaxP, N, L = 1, 10, 2, 16, 4, 4, 8, 3
+    q, k, v, table = _rand_case(rng, B, S, K, D, P, MaxP, N)
+    start = jnp.zeros((B,), jnp.int32)
+    lens = jnp.full((B,), S, jnp.int32)
+    pages = QuantizedPages(
+        jnp.zeros((L, N, P, K, D), jnp.int8),
+        jnp.ones((L, N, P, K), jnp.float32),
+    )
+    kq = write_kv_pages(
+        pages, pages, k, v, table, start,
+        valid_len=lens, layer=jnp.int32(2),
+    )[0]
+    q1 = q[:, -1]
+    ref = paged_decode_attention(q1, kq, kq, table, lens, layer=jnp.int32(2))
+    got = paged_decode_attention_pallas_dma(
+        q1, kq, kq, table, lens, interpret=True, layer=jnp.int32(2)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_pallas_dma_quantized_under_tp_matches_oracle():
+    """QuantizedPages through the tp shard_map wrapper: the scale-plane
+    PartitionSpec pytree must mirror the leaf structure and put tp on the
+    kv-head axis (one fewer trailing dim than the values)."""
+    import jax
+
+    from opsagent_tpu.ops.attention import paged_decode_attention_pallas_tp
+    from opsagent_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs >= 2 devices")
+    mesh = make_mesh(tp=2, dp=1, sp=1, devices=jax.devices()[:2])
+    rng = np.random.default_rng(7)
+    B, S, K, D, P, MaxP, N = 2, 17, 2, 32, 8, 4, 10
+    q, k, v, table = _rand_case(rng, B, S, K, D, P, MaxP, N)
+    start = jnp.zeros((B,), jnp.int32)
+    lens = jnp.full((B,), S, jnp.int32)
+    kq, vq = write_kv_pages(
+        _pages(N, P, K, D, True), _pages(N, P, K, D, True),
+        k, v, table, start, valid_len=lens,
+    )
+    q1 = q[:, -1]
+    ref = paged_decode_attention(q1, kq, vq, table, lens)
+    got = paged_decode_attention_pallas_tp(
+        q1, kq, vq, table, lens, mesh, interpret=True, impl="pallas-dma",
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
 # -- engine wiring -----------------------------------------------------------
 
 def _engine_kwargs():
@@ -169,6 +262,26 @@ def test_engine_kv_quantize_greedy_matches_fp_cache():
             eng.step_block([sid])
         outs.append(eng.finish(sid))
     assert outs[0] == outs[1]
+
+
+def test_engine_keeps_pallas_dma_with_kv_quantize_at_aligned_head_dim(
+    monkeypatch,
+):
+    """kv_quantize no longer forces xla when the manual-DMA kernel (which
+    has a quantized path) is selected AND the head dim satisfies its
+    alignment rule."""
+    from dataclasses import replace
+
+    from opsagent_tpu.models.config import get_config_preset
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    monkeypatch.setenv("OPSAGENT_PAGED_BACKEND", "pallas-dma")
+    cfg128 = replace(get_config_preset("tiny-test"), head_dim=128)
+    eng = Engine(
+        EngineConfig(kv_quantize="int8", warmup=False, **_engine_kwargs()),
+        model_cfg=cfg128,
+    )
+    assert eng.attn_impl == "pallas-dma"
 
 
 def test_engine_rejects_bad_kv_quantize_and_mla_combo():
