@@ -155,13 +155,19 @@ def _kernel_dma(
     clamped-index pipeline step.
 
     ``quantized``: pages are int8 and two extra VMEM blocks carry the
-    pre-gathered per-token-per-head f32 scales for THIS sequence
-    ([1, MaxP, P, K] each — the scale planes are 1/D of the page bytes,
-    so the caller's XLA gather of them is noise); each streamed page is
-    dequantized in VMEM right after its DMA completes. The scale planes
-    ride the automatic BlockSpec pipeline rather than manual DMAs
-    because their minormost dim (K, typically 8) cannot satisfy
-    Mosaic's 128-lane alignment rule for manual memref slices."""
+    pre-gathered, pre-FLATTENED per-token-per-head f32 scales for THIS
+    sequence ([1, MaxP, P*K] each — the scale planes are 1/D of the page
+    bytes, so the caller's XLA gather of them is noise). The scales ride
+    the automatic BlockSpec pipeline (lane dim P*K, naturally
+    128-aligned) rather than manual DMAs, and are applied in SCORE space,
+    not value space: column c of the [H, P*K] score matrix is (token
+    c//K, kv head c%K) — exactly the flat scale vector's order — so
+    ``s = (q . K_int8) * k_scale[None, :]`` and ``acc += (probs *
+    v_scale[None, :]) . V_int8`` are plain lane-wise multiplies,
+    mathematically identical to dequantizing the pages (the scale is
+    constant per column) while avoiding the [P, K] -> [P, K, D]
+    broadcast whose lane->sublane relayout Mosaic lowers badly or not
+    at all."""
     if quantized:
         (q_ref, k_hbm, v_hbm, k_sc_ref, v_sc_ref, o_ref,
          k_buf, v_buf, k_sem, v_sem, acc_ref, m_ref, l_ref) = refs
@@ -216,21 +222,23 @@ def _kernel_dma(
         k_dma(slot, i).wait()
         v_dma(slot, i).wait()
 
-        kb = k_buf[slot]
-        vb = v_buf[slot]
+        kf = k_buf[slot].reshape(P * K, D)
+        vf = v_buf[slot].reshape(P * K, D)
         if quantized:
-            # Dequantize the streamed int8 page in VMEM: [P, K] scales
-            # broadcast over the head dim. f32 keeps the dot exact; the
-            # attention FLOPs are trivial next to the HBM stream.
-            kb = kb.astype(jnp.float32) * k_sc_ref[0, i][..., None]
-            vb = vb.astype(jnp.float32) * v_sc_ref[0, i][..., None]
-        kf = kb.reshape(P * K, D)
-        vf = vb.reshape(P * K, D)
+            # int8 values <= 127 are exact in f32; the MXU dot runs on
+            # converted operands rather than a mixed int8 x f32 dot.
+            kf = kf.astype(jnp.float32)
         s_full = jax.lax.dot_general(
             q, kf,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                   # [H, P*K]
+        if quantized:
+            # Column c = (token c//K, kv head c%K) — the flat scale
+            # vector's exact order, so applying the K scale in score
+            # space is a lane-wise multiply identical to dequantizing
+            # the page (the scale is constant per column).
+            s_full = s_full * k_sc_ref[0, i][None, :]
         col = jax.lax.broadcasted_iota(jnp.int32, (H, P * K), 1)
         row = jax.lax.broadcasted_iota(jnp.int32, (H, P * K), 0)
         sel = (col % K == row // G) & (i * P + col // K < length)
@@ -241,8 +249,12 @@ def _kernel_dma(
         alpha = jnp.exp(m_prev - m_new)
         probs = jnp.exp(s - m_new)
         l_new = alpha[:, 0] * l_ref[:, 0] + jnp.sum(probs, axis=-1)
+        pv = probs
+        if quantized:
+            # V scale folds into the probs the same way (per-column).
+            pv = probs * v_sc_ref[0, i][None, :]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            probs, vf.astype(jnp.float32),
+            pv, vf.astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -278,10 +290,13 @@ def paged_decode_attention_pallas_dma(
     Accepts ``ops.attention.QuantizedPages`` (int8 values + per-token
     scales): the int8 pages stream through the manual DMAs exactly like
     bf16 ones (HALF the bytes), while THIS sequence's scale planes — 1/D
-    of the page bytes — are XLA-gathered outside and pipelined into VMEM
-    as ordinary blocks; dequantize happens in VMEM per streamed page.
-    This composes the kernel's read-only-resident-pages win with KV
-    quantization's bytes-per-token win."""
+    of the page bytes — are XLA-gathered outside, flattened to
+    [B, MaxP, P*K], and pipelined into VMEM as ordinary blocks; the
+    kernel applies them as per-column multiplies in score/probs space
+    (mathematically identical to dequantizing the pages — see
+    ``_kernel_dma``). This composes the kernel's
+    read-only-resident-pages win with KV quantization's bytes-per-token
+    win."""
     from .attention import QuantizedPages
 
     if q.shape[-1] % 128 != 0 and not interpret:
@@ -322,14 +337,20 @@ def paged_decode_attention_pallas_dma(
     operands = [q, k_pages, v_pages]
     if quantized:
         # Per-sequence scale planes, gathered OUTSIDE the kernel (tiny:
-        # 4 bytes per D int8 values) and pipelined per grid step.
+        # 4 bytes per D int8 values), FLATTENED to [B, MaxP, P*K] so the
+        # lane dim is naturally 128-aligned and the kernel applies them
+        # as per-column multiplies in score space (see _kernel_dma), and
+        # pipelined per grid step.
         safe_table = jnp.clip(page_table + base, 0, nmax)
         sc_spec = pl.BlockSpec(
-            (1, MaxP, P, K), lambda b, t, ln, ba: (b, 0, 0, 0),
+            (1, MaxP, P * K), lambda b, t, ln, ba: (b, 0, 0),
             memory_space=pltpu.VMEM,
         )
         in_specs += [sc_spec, sc_spec]
-        operands += [k_scale[safe_table], v_scale[safe_table]]
+        operands += [
+            k_scale[safe_table].reshape(B, MaxP, P * K),
+            v_scale[safe_table].reshape(B, MaxP, P * K),
+        ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
