@@ -30,5 +30,24 @@ OPSAGENT_PROFILE_DIR="$OUT/trace" OPSAGENT_BENCH_MODEL=bench-1b \
   >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
 echo "profile rc=$?" | tee -a "$OUT/session.log"
 
+# Page-geometry sweep on the 8B headline (the XLA gather reads full
+# table CAPACITY per step, so geometry matters on that backend; the dma
+# kernel reads resident pages only). Each point is one short run; a
+# failed point just logs and moves on.
+echo "== 8B sweep points ==" | tee -a "$OUT/session.log"
+sweep() {  # tag env...
+  local tag="$1"; shift
+  echo "-- sweep $tag" | tee -a "$OUT/session.log"
+  env "$@" OPSAGENT_BENCH_MODEL=bench-8b OPSAGENT_BENCH_STEPS=384 \
+    timeout 420 python bench.py \
+    >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
+  echo "-- sweep $tag rc=$?" | tee -a "$OUT/session.log"
+}
+sweep page128-kv   OPSAGENT_BENCH_PAGE=128 OPSAGENT_BENCH_MAXPAGES=6 \
+                   OPSAGENT_BENCH_KV=int8
+sweep page128      OPSAGENT_BENCH_PAGE=128 OPSAGENT_BENCH_MAXPAGES=6
+sweep dma-int4-kv  OPSAGENT_PAGED_BACKEND=pallas-dma \
+                   OPSAGENT_BENCH_QUANT=int4 OPSAGENT_BENCH_KV=int8
+
 echo "results in $OUT:" | tee -a "$OUT/session.log"
 cat "$OUT/bench.jsonl"
