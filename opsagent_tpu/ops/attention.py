@@ -82,12 +82,20 @@ def _dequantize_gathered(seq: jax.Array, scale: jax.Array, dtype) -> jax.Array:
 
 
 def paged_attention_backend() -> str:
-    """Which decode-attention implementation to use: "pallas" (TPU kernel)
-    or "xla" (gather-based reference). Env OPSAGENT_PAGED_BACKEND overrides;
-    default picks the Pallas kernel on TPU regardless of tensor parallelism
-    — under tp the kernel runs inside a shard_map over the tp axis (kv
-    heads are tp-sharded, so each device streams only its own heads'
-    pages)."""
+    """Which decode-attention implementation to use: "xla" (gather-based),
+    "pallas" ((B, MaxP) grid kernel), or "pallas-dma" (manual
+    double-buffered page streaming). Env OPSAGENT_PAGED_BACKEND overrides.
+
+    Default is "xla" EVERYWHERE — by measurement, not preference: the
+    r01 on-chip comparison had the gather beating the grid kernel at
+    decode shapes (per-page pipeline-step overhead), and r04's only
+    successful on-chip runs (1B 4775 / 8B-int8 1899 tok/s/chip) are xla
+    numbers. "pallas-dma" exists to beat the gather's
+    capacity-proportional reads and is expected to become the TPU
+    default, but ONLY once the on-chip sweep (bench pallas-dma stages)
+    shows it winning — interpret-mode tests cover semantics, not Mosaic
+    lowering or speed, and its first compile attempt on hardware failed
+    (head_dim alignment, r04)."""
     choice = os.environ.get("OPSAGENT_PAGED_BACKEND", "auto")
     if choice in ("pallas", "pallas-dma", "xla"):
         return choice
@@ -96,11 +104,7 @@ def paged_attention_backend() -> str:
             f"OPSAGENT_PAGED_BACKEND={choice!r}: expected pallas, "
             f"pallas-dma, xla, or auto"
         )
-    # "pallas-dma" (manual double-buffered page streaming) is the intended
-    # TPU default once compile-verified on hardware; until then auto keeps
-    # the proven grid kernel (interpret-mode tests cover semantics, not
-    # Mosaic lowering).
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return "xla"
 
 
 def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
