@@ -241,7 +241,7 @@ def test_run_real_checkpoint_script_auto_config(tmp_path):
             "--max-pages-per-seq", "1024",
             "--transcript", str(tmp_path / "transcript.md"),
         ],
-        capture_output=True, text=True, timeout=1500, env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=3000, env=env, cwd=REPO,
     )
     assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
     last = out.stdout.strip().splitlines()[-1]
@@ -455,7 +455,7 @@ def test_run_real_checkpoint_script_deepseek_auto(tmp_path):
             "--max-pages-per-seq", "1024",
             "--transcript", str(tmp_path / "transcript.md"),
         ],
-        capture_output=True, text=True, timeout=1500, env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=3000, env=env, cwd=REPO,
     )
     assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
     last = out.stdout.strip().splitlines()[-1]
