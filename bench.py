@@ -396,18 +396,7 @@ def run_single() -> None:
     # sweep probe that tradeoff without code edits.
     page_size = int(os.environ.get("OPSAGENT_BENCH_PAGE", "64"))
     max_pages = int(os.environ.get("OPSAGENT_BENCH_MAXPAGES", "12"))
-    # Fail fast on undersized sweep points: OutOfPages mid-window would
-    # force-finish sequences ('length') and quietly deflate the metric.
-    # Lookahead slack: decode_block x (pipeline_depth + 1) pre-booked
-    # tokens (EngineConfig defaults 32 x 3).
-    need = prompt_len + steps + 96
-    if page_size * max_pages < need:
-        raise SystemExit(
-            f"bench: page geometry {page_size}x{max_pages} holds "
-            f"{page_size * max_pages} tokens < {need} needed "
-            f"(prompt {prompt_len} + steps {steps} + lookahead 96); "
-            f"raise OPSAGENT_BENCH_MAXPAGES or lower OPSAGENT_BENCH_STEPS"
-        )
+    decode_block = int(os.environ.get("OPSAGENT_BENCH_BLOCK", "32"))
     cfg = EngineConfig(
         model=model,
         dtype=dtype,
@@ -419,7 +408,22 @@ def run_single() -> None:
         quantize=quantize,
         kv_quantize=kv_quantize,
         speculative_k=spec_k,
+        decode_block=decode_block,
     )
+    # Fail fast on undersized sweep points: OutOfPages mid-window would
+    # force-finish sequences ('length') and quietly deflate the metric.
+    # Lookahead slack from the EFFECTIVE config, so a changed
+    # pipeline_depth default cannot silently undersize the guard.
+    lookahead = cfg.decode_block * (cfg.pipeline_depth + 1)
+    need = prompt_len + steps + lookahead
+    if cfg.page_size * cfg.max_pages_per_seq < need:
+        raise SystemExit(
+            f"bench: page geometry {cfg.page_size}x{cfg.max_pages_per_seq} "
+            f"holds {cfg.page_size * cfg.max_pages_per_seq} tokens < "
+            f"{need} needed (prompt {prompt_len} + steps {steps} + "
+            f"lookahead {lookahead}); raise OPSAGENT_BENCH_MAXPAGES or "
+            f"lower OPSAGENT_BENCH_STEPS"
+        )
     t0 = time.perf_counter()
     eng = Engine(cfg)
     init_s = time.perf_counter() - t0
@@ -514,6 +518,8 @@ def run_single() -> None:
             "chips": n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "decode_block": eng.cfg.decode_block,
+            "page_size": eng.cfg.page_size,
         },
     }), flush=True)
 

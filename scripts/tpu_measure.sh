@@ -48,6 +48,7 @@ sweep page128-kv   OPSAGENT_BENCH_PAGE=128 OPSAGENT_BENCH_MAXPAGES=6 \
 sweep page128      OPSAGENT_BENCH_PAGE=128 OPSAGENT_BENCH_MAXPAGES=6
 sweep dma-int4-kv  OPSAGENT_PAGED_BACKEND=pallas-dma \
                    OPSAGENT_BENCH_QUANT=int4 OPSAGENT_BENCH_KV=int8
+sweep block64-kv   OPSAGENT_BENCH_BLOCK=64 OPSAGENT_BENCH_KV=int8
 
 echo "results in $OUT:" | tee -a "$OUT/session.log"
 cat "$OUT/bench.jsonl"
