@@ -29,18 +29,21 @@ def test_train_serve_agent_roundtrip(tmp_path):
             sys.executable, "-u",
             os.path.join(REPO, "scripts", "train_tiny_agent.py"),
             "--steps", "600",
-            # One extra SERVING pass (training happens once): the same
-            # checkpoint re-served with the int8 KV cache must reproduce
-            # every memorized assertion — greedy faithfulness under KV
-            # quantization on LEARNED weights, not random ones.
-            "--kv-quantize", "int8",
+            # Extra SERVING passes (training happens once): the same
+            # checkpoint re-served under each quantized configuration
+            # must reproduce every memorized assertion — greedy
+            # faithfulness on LEARNED weights, not random ones. int8 KV
+            # and int8 weights gate; int4 is report-only (tiny-test's
+            # 64-wide contractions are group-wise int4's worst case).
+            "--serve-variants", "kv-int8,int8,int4",
             "--out", str(tmp_path / "ckpt"),
         ],
-        capture_output=True, text=True, timeout=1200, env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=1800, env=env, cwd=REPO,
     )
     assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
     assert "agent PASSED" in out.stdout
-    assert "re-serving with kv_quantize=int8" in out.stderr
+    assert "[kv-int8]" in out.stderr and "[int8]" in out.stderr
+    assert "int4 variant" in out.stderr  # ran, report-only
     assert (tmp_path / "ckpt" / "model.safetensors").exists()
 
 
