@@ -187,6 +187,58 @@ def test_pallas_dma_quantized_layer_form():
     )
 
 
+@pytest.mark.slow
+def test_pallas_dma_quantized_at_bench_8b_decode_shape():
+    """Interpret parity at the EXACT pallas-dma-kv bench stage shape
+    (B=32, K=8, D=128, P=64, MaxP=12, int8 pages, ragged + one full row)
+    — validated before the stage burns chip time, like the bf16 twin in
+    test_pallas_paged."""
+    from opsagent_tpu.ops.attention import QuantizedPages
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(43)
+    B, K, D, P, MaxP, N = 32, 8, 128, 64, 12, 32 * 12 + 2
+    H = 32
+    lengths = np.asarray(
+        [MaxP * P] + [int(rng.integers(1, MaxP * P + 1)) for _ in range(B - 1)],
+        np.int32,
+    )
+    table = np.full((B, MaxP), -1, np.int32)
+    free = list(range(N))
+    for b in range(B):
+        for i in range(-(-int(lengths[b]) // P)):
+            table[b, i] = free.pop()
+    # f32 queries: both paths then compute in f32 and must agree tightly
+    # (the kernel applies scales in score space, the reader dequantizes —
+    # algebraically identical). bf16 rounding-order differences between
+    # the two paths are covered by the bf16 twin in test_pallas_paged;
+    # THIS test de-risks grid/scratch/indexing at the exact stage shape.
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kq = QuantizedPages(
+        jnp.asarray(rng.integers(-127, 128, size=(N, P, K, D)), jnp.int8),
+        jnp.asarray(rng.uniform(0.01, 0.2, size=(N, P, K)), jnp.float32),
+    )
+    vq = QuantizedPages(
+        jnp.asarray(rng.integers(-127, 128, size=(N, P, K, D)), jnp.int8),
+        jnp.asarray(rng.uniform(0.01, 0.2, size=(N, P, K)), jnp.float32),
+    )
+    tbl = jnp.asarray(table)
+    lens = jnp.asarray(lengths)
+    ref = paged_decode_attention(q, kq, vq, tbl, lens)
+    got = paged_decode_attention_pallas_dma(
+        q, kq, vq, tbl, lens, interpret=True
+    )
+    # atol 1e-3: f32 blockwise online softmax vs the reference's full
+    # softmax reorder accumulation over up to 768 tokens; observed worst
+    # deviation ~3e-4 on near-zero outputs.
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=1e-3, atol=1e-3,
+    )
+
+
 def test_pallas_dma_quantized_under_tp_matches_oracle():
     """QuantizedPages through the tp shard_map wrapper: the scale-plane
     PartitionSpec pytree must mirror the leaf structure and put tp on the
