@@ -346,6 +346,29 @@ def test_engine_rejects_bad_kv_quantize_and_mla_combo():
         Engine(EngineConfig(kv_quantize="int8", **kwargs))
 
 
+def test_engine_kv_quantize_speculative_matches_plain():
+    """Speculative decoding over the quantized cache (verify_step writes
+    and reads QuantizedPages) must emit exactly the plain quantized
+    engine's greedy tokens — speculation is exact for greedy regardless
+    of the cache's storage format."""
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    prompt = [7, 8, 9, 7, 8, 9, 7, 8]  # repetitive: lets drafts engage
+    outs = []
+    for k in (0, 3):
+        eng = Engine(EngineConfig(
+            kv_quantize="int8", speculative_k=k, **_engine_kwargs()
+        ))
+        sid = eng.begin_request(
+            prompt, SamplingParams(max_tokens=10, temperature=0.0)
+        )
+        while not eng.sequences[sid].done:
+            eng.step_block([sid])
+        outs.append(eng.finish(sid))
+    assert outs[0] == outs[1]
+
+
 def test_engine_kv_quantize_under_tp_mesh():
     """Quantized pages (values AND scales) must shard over tp and execute."""
     import jax
