@@ -381,7 +381,13 @@ def test_run_real_checkpoint_script_deepseek_auto(tmp_path):
     config.json (MLA + sigmoid MoE) -> config_from_hf -> loader (HF
     deepseek weight names incl. router e_score_correction_bias) ->
     latent-cache engine -> FSM-constrained agent loop. The same flow a
-    real V2-Lite/V3 download takes, at toy scale with random weights."""
+    real V2-Lite/V3 download takes, at toy scale with random weights.
+
+    The heaviest test in the suite (~10 min solo: full production warmup
+    of an MLA MoE engine on CPU). It passes solo reliably but can starve
+    past its subprocess timeout when run under a fully loaded
+    ``pytest -n`` box — run it in the slow lane / a lightly loaded
+    worker, not sandwiched into a saturated parallel session."""
     import jax
     import jax.numpy as jnp
     import numpy as np
