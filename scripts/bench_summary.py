@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Summarize a bench.jsonl (from bench.py / scripts/tpu_measure.sh) into
+the decision matrix PERF.md keys its defaults on.
+
+Usage: python scripts/bench_summary.py tpu_results_*/bench.jsonl
+
+Groups result lines by configuration, prints tok/s/chip + TTFT side by
+side, and answers the open questions explicitly: fastest 8B variant
+(headline candidate), xla-vs-pallas-dma kernel verdict, sessions p50
+TTFT vs the 500 ms target, cold-restart numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(paths: list[str]) -> int:
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "metric" in d:
+                    rows.append(d)
+    if not rows:
+        print("no result lines found", file=sys.stderr)
+        return 1
+
+    print(f"{'metric':58s} {'tok/s/chip':>10s} {'p50 TTFT':>9s} "
+          f"{'backend':>10s} {'vs_base':>8s}")
+    for d in rows:
+        e = d.get("extra", {})
+        vb = d.get("vs_baseline")
+        print(f"{d['metric'][:58]:58s} {d['value']:>10.1f} "
+              f"{e.get('p50_ttft_ms', 0) or 0:>8.0f}m "
+              f"{e.get('paged_backend', '') or '-':>10s} "
+              f"{vb if vb is not None else '-':>8}")
+
+    # Decision answers (best-effort from metric names).
+    tpu = [d for d in rows if ",tpu]" in d["metric"]]
+    eight_b = [d for d in tpu if "bench-8b" in d["metric"]
+               and "concurrent" not in d["metric"]]
+    if eight_b:
+        best = max(eight_b, key=lambda d: d["value"])
+        print(f"\nfastest 8B variant: {best['metric']} "
+              f"at {best['value']:.0f} tok/s/chip "
+              f"({'>=' if best['value'] >= 2000 else '<'} 2000 target)")
+        dma = [d for d in eight_b
+               if d.get("extra", {}).get("paged_backend") == "pallas-dma"]
+        xla = [d for d in eight_b
+               if d.get("extra", {}).get("paged_backend") in ("", "xla")]
+        if dma and xla:
+            print(f"kernel verdict: pallas-dma best "
+                  f"{max(d['value'] for d in dma):.0f} vs xla best "
+                  f"{max(d['value'] for d in xla):.0f}")
+    sess = [d for d in tpu if "concurrent_sessions" in d["metric"]]
+    if sess:
+        p50 = sess[-1].get("extra", {}).get("p50_ttft_ms", 0)
+        print(f"sessions p50 TTFT: {p50:.0f} ms "
+              f"({'<' if p50 < 500 else '>='} 500 ms target)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] or ["tpu_results_r04/bench.jsonl"]))
