@@ -316,11 +316,9 @@ def paged_decode_attention_pallas_dma(
             k_scale = k_scale.reshape(Lr * N, P, K)
             v_scale = v_scale.reshape(Lr * N, P, K)
         base = (layer if layer is not None else 0) * N
-        nmax = Lr * N - 1
     else:
         N, P, K, D = k_pages.shape
         base = 0
-        nmax = N - 1
     B, H, _ = q.shape
     MaxP = page_table.shape[1]
     base_arr = jnp.full((1,), base, jnp.int32)
@@ -341,7 +339,11 @@ def paged_decode_attention_pallas_dma(
         # lane dim is naturally 128-aligned and the kernel applies them
         # as per-column multiplies in score space (see _kernel_dma), and
         # pipelined per grid step.
-        safe_table = jnp.clip(page_table + base, 0, nmax)
+        # Same index math as the kernel's DMA (max(slot, 0) + base), so
+        # the value and scale planes can never come from different pages
+        # for an unassigned (-1) slot; such slots are masked anyway, but
+        # the invariant should hold structurally, not by masking luck.
+        safe_table = jnp.maximum(page_table, 0) + base
         sc_spec = pl.BlockSpec(
             (1, MaxP, P * K), lambda b, t, ln, ba: (b, 0, 0),
             memory_space=pltpu.VMEM,
