@@ -25,8 +25,11 @@ python bench.py > "$OUT/bench.jsonl" 2> >(tee -a "$OUT/session.log" >&2)
 echo "bench rc=$?" | tee -a "$OUT/session.log"
 
 echo "== profiled 1B steady state ==" | tee -a "$OUT/session.log"
+# Generous cap: SIGTERM'ing a device-holding child wedges the remote lease
+# (r04 lesson) — the timeout exists only as a last-resort backstop, sized
+# at ~3x the expected runtime so it never fires on a healthy run.
 OPSAGENT_PROFILE_DIR="$OUT/trace" OPSAGENT_BENCH_MODEL=bench-1b \
-  OPSAGENT_BENCH_STEPS=256 timeout 600 python bench.py \
+  OPSAGENT_BENCH_STEPS=256 timeout 1500 python bench.py \
   >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
 echo "profile rc=$?" | tee -a "$OUT/session.log"
 
@@ -39,7 +42,7 @@ sweep() {  # tag env...
   local tag="$1"; shift
   echo "-- sweep $tag" | tee -a "$OUT/session.log"
   env "$@" OPSAGENT_BENCH_MODEL=bench-8b OPSAGENT_BENCH_STEPS=384 \
-    timeout 420 python bench.py \
+    timeout 900 python bench.py \
     >> "$OUT/bench.jsonl" 2>> "$OUT/session.log"
   echo "-- sweep $tag rc=$?" | tee -a "$OUT/session.log"
 }
@@ -49,6 +52,11 @@ sweep page128      OPSAGENT_BENCH_PAGE=128 OPSAGENT_BENCH_MAXPAGES=6
 sweep dma-int4-kv  OPSAGENT_PAGED_BACKEND=pallas-dma \
                    OPSAGENT_BENCH_QUANT=int4 OPSAGENT_BENCH_KV=int8
 sweep block64-kv   OPSAGENT_BENCH_BLOCK=64 OPSAGENT_BENCH_KV=int8
+# North-star shape on the north-star model class: multi-turn agent
+# sessions on 8B (the orchestrated run measures it on bench-1b). N=8
+# keeps weights + KV pages inside the 16 GB chip.
+sweep agent-8b     OPSAGENT_BENCH_MODE=agent OPSAGENT_BENCH_BATCH=8 \
+                   OPSAGENT_BENCH_KV=int8
 
 echo "results in $OUT:" | tee -a "$OUT/session.log"
 cat "$OUT/bench.jsonl"

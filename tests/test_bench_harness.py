@@ -132,3 +132,30 @@ def test_vs_baseline_null_unless_tpu_and_8b_class():
     # json.dumps renders the None as null, never a number.
     assert json.dumps({"vs_baseline": bench.vs_baseline(1.0, "x", "cpu")}) \
         == '{"vs_baseline": null}'
+
+
+def test_agent_mode_reports_per_turn_ttft_and_hit_rate():
+    """OPSAGENT_BENCH_MODE=agent (the north-star shape: multi-turn ReAct
+    sessions, full-history resend, prefix cache on) must complete every
+    turn without OutOfPages — the page budget is sized from the final
+    turn's history, not the linear-decode guard — and report per-turn
+    TTFT plus a nonzero prefix-hit rate."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "agent",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+        "OPSAGENT_BENCH_TURNS": "3",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("agent_turn_ttft[")
+    assert parsed["unit"] == "ms"
+    assert parsed["vs_baseline"] is None
+    e = parsed["extra"]
+    assert e["errors"] == 0
+    assert e["turns_completed"] == 3 * 3
+    assert e["prefix_hit_rate"] > 0  # turn >= 2 prompts must hit the trie
+    assert e["turn1_p50_ttft_ms"] > 0
