@@ -139,6 +139,24 @@ TASKS_MULTI = [dict(
         final="There are 2 pods in the default namespace.",
     ),
     dict(
+        # Third tool family (jq): the input embeds JSON-in-a-string —
+        # the hardest wire shape the FSM-constrained decode must emit
+        # byte-exactly (nested quotes escape through two JSON layers).
+        instruction="extract the first item name from the status json",
+        phrasings=["pull the first item's name out of the status json",
+                   "use jq to get the first item name from the status json",
+                   "what is the first item's name in the status json",
+                   "read the first item name from the status json with jq"],
+        tool="jq",
+        tool_input='{"items":[{"name":"web-2","status":'
+                   '"CrashLoopBackOff"}]} | .items[0].name',
+        observation='"web-2"',
+        thought1="I will extract the name with the jq tool.",
+        thought2="The first item is named web-2.",
+        obs2="The first item is web-2.",
+        final="The first item in the status json is web-2.",
+    ),
+    dict(
         instruction="compute 6*7 using python",
         phrasings=["use python to compute 6*7",
                    "run python to calculate 6*7",
@@ -309,7 +327,16 @@ def main() -> int:
                          "re-run the assertions under from the SAME "
                          "checkpoint: kv-int8 (int8 KV cache), int8 "
                          "(weight-only int8), int4 (weight-only int4, "
-                         "report-only at this scale)")
+                         "gated on greedy agreement, see --int4-floor)")
+    ap.add_argument("--int4-floor", type=float, default=0.35,
+                    help="minimum mean greedy matching-prefix fraction "
+                         "the int4 serve must reach vs the fp32 serve of "
+                         "the same checkpoint (VERDICT r04 #6). The floor "
+                         "separates 'lossy but sane' from 'broken': a "
+                         "packing/dequant BUG craters agreement to ~0, "
+                         "while legitimate small-group noise on this "
+                         "worst-case model (64-wide contractions = "
+                         "whole-axis scale groups) stays well above it")
     ap.add_argument("--kv-quantize", default="", choices=("", "int8"),
                     help="after the plain serving run passes, re-serve "
                          "the SAME checkpoint with the int8 KV cache and "
@@ -413,11 +440,12 @@ def main() -> int:
     # Re-serve the SAME checkpoint under each requested quantized
     # configuration and rerun the memorized assertions: greedy
     # faithfulness on LEARNED weights at one extra serving pass each
-    # (training is the expensive part and happens once). int4 is
-    # REPORT-ONLY: tiny-test's 64-wide contraction axes collapse to
-    # whole-axis scale groups — group-wise int4's worst case — so a
-    # flipped answer there is expected signal, not a gate (PERF.md keeps
-    # int4 fidelity an open question for real-scale weights).
+    # (training is the expensive part and happens once). int4's ANSWERS
+    # are non-gating — tiny-test's 64-wide contraction axes collapse to
+    # whole-axis scale groups, group-wise int4's worst case, so a
+    # flipped answer is expected signal — but int4 DOES gate on greedy
+    # prefix agreement vs the fp32 serve (--int4-floor; PERF.md "int4
+    # fidelity policy"): a packing/dequant bug fails the run.
     variants = [v for v in (args.serve_variants or "").split(",") if v]
     if args.kv_quantize and "kv-int8" not in variants:
         variants.insert(0, "kv-int8")
@@ -434,11 +462,81 @@ def main() -> int:
         got = run_agent(ckpt, tok_path, cfg, tasks, probe=False,
                         kv_quantize=kvq, quantize=wq)
         if v == "int4":
-            print(f"int4 variant {'PASSED' if got else 'FAILED'} "
-                  f"(report-only)", file=sys.stderr)
+            # int4's answer-level pass is NOT the gate at this scale
+            # (tiny-test's 64-wide contractions collapse to whole-axis
+            # scale groups — group-wise int4's worst case, so a flipped
+            # answer is expected signal). The GATE is quantitative
+            # greedy agreement vs the fp32 serve (VERDICT r04 #6): a
+            # packing/dequant bug craters it to ~0, quantization noise
+            # does not.
+            agree = greedy_agreement(
+                ckpt, tok_path, cfg, tasks, quantize="int4"
+            )
+            print(f"int4 variant {'PASSED' if got else 'DIVERGED'} "
+                  f"(answers non-gating); greedy prefix agreement vs "
+                  f"fp32 {agree:.3f} (gate floor {args.int4_floor})",
+                  file=sys.stderr)
+            if agree < args.int4_floor:
+                print(f"int4 agreement {agree:.3f} < floor "
+                      f"{args.int4_floor}: FAILED", file=sys.stderr)
+                ok = False
         else:
             ok = got
     return 0 if ok else 1
+
+
+def greedy_agreement(ckpt: str, tok_path: str, cfg, tasks,
+                     quantize: str = "", kv_quantize: str = "",
+                     max_tokens: int = 64) -> float:
+    """Mean greedy matching-prefix fraction of a quantized serve vs the
+    fp32 serve of the SAME checkpoint, over each task's turn-1 prompt
+    (chat-templated by the serving path's own apply_chat_template).
+    Prefix fraction, not positionwise match: greedy divergence compounds,
+    so the first differing token ends the credited run — the strictest
+    honest scalar for 'how far does the quantized model track fp32'."""
+    from opsagent_tpu.serving.chat_template import apply_chat_template
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    def gen(wq: str, kvq: str) -> list[list[int]]:
+        eng = Engine(
+            EngineConfig(
+                model="tiny-test", checkpoint=ckpt, tokenizer=tok_path,
+                dtype=jnp.float32, num_pages=256, page_size=16,
+                max_pages_per_seq=64, max_batch_size=1,
+                prefill_buckets=(128, 512, 1024),
+                quantize=wq, kv_quantize=kvq,
+            ),
+            model_cfg=cfg,
+        )
+        outs = []
+        for t in tasks:
+            messages = [
+                {"role": "system", "content": SYS_PROMPT},
+                {"role": "user",
+                 "content": f"Here are the instructions: "
+                            f"{t['instruction']}"},
+            ]
+            ids = apply_chat_template(eng.tokenizer, messages)
+            sid = eng.add_request(
+                ids, SamplingParams(temperature=0.0, max_tokens=max_tokens)
+            )
+            while not eng.sequences[sid].done:
+                eng.step([sid])
+            outs.append(eng.finish(sid))
+        return outs
+
+    ref = gen("", "")
+    got = gen(quantize, kv_quantize)
+    fracs = []
+    for a, b in zip(ref, got):
+        n = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            n += 1
+        fracs.append(n / max(1, len(a)))
+    return sum(fracs) / max(1, len(fracs))
 
 
 def run_agent(ckpt: str, tok_path: str, cfg, tasks=None,

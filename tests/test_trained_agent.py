@@ -33,8 +33,11 @@ def test_train_serve_agent_roundtrip(tmp_path):
             # checkpoint re-served under each quantized configuration
             # must reproduce every memorized assertion — greedy
             # faithfulness on LEARNED weights, not random ones. int8 KV
-            # and int8 weights gate; int4 is report-only (tiny-test's
-            # 64-wide contractions are group-wise int4's worst case).
+            # and int8 weights gate on the answers; int4 gates on greedy
+            # prefix agreement vs fp32 (tiny-test's 64-wide contractions
+            # are group-wise int4's worst case, so flipped ANSWERS are
+            # expected signal there — but agreement ~0 means a
+            # packing/dequant bug and fails the run).
             "--serve-variants", "kv-int8,int8,int4",
             "--out", str(tmp_path / "ckpt"),
         ],
@@ -43,16 +46,19 @@ def test_train_serve_agent_roundtrip(tmp_path):
     assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
     assert "agent PASSED" in out.stdout
     assert "[kv-int8]" in out.stderr and "[int8]" in out.stderr
-    assert "int4 variant" in out.stderr  # ran, report-only
+    # int4 ran AND its quantitative gate reported (a floor breach would
+    # have failed the returncode assertion above).
+    assert "greedy prefix agreement vs fp32" in out.stderr
     assert (tmp_path / "ckpt" / "model.safetensors").exists()
 
 
 @pytest.mark.slow
 def test_train_serve_agent_multi_task(tmp_path):
-    """The 6-instruction corpus (5 kubectl episodes + 1 python-tool
-    episode) trains to memorization and the served agent answers EVERY
-    instruction correctly through the real loop — tool dispatch across
-    two tools, FSM-constrained decode, replay cluster."""
+    """The 7-instruction corpus (5 kubectl episodes + 1 python-tool
+    episode + 1 jq episode) trains to memorization and the served agent
+    answers EVERY instruction correctly through the real loop — tool
+    dispatch across three tools, FSM-constrained decode, replay
+    cluster."""
     env = {k: v for k, v in os.environ.items()
            if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
@@ -61,14 +67,14 @@ def test_train_serve_agent_multi_task(tmp_path):
             sys.executable, "-u",
             os.path.join(REPO, "scripts", "train_tiny_agent.py"),
             "--tasks", "multi",
-            "--steps", "2000",
+            "--steps", "3000",
             "--no-probe",  # held-out probes are demo-only wall clock
             "--out", str(tmp_path / "ckpt"),
         ],
-        capture_output=True, text=True, timeout=1500, env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=2400, env=env, cwd=REPO,
     )
     assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
-    assert "agent PASSED (6 tasks)" in out.stdout
+    assert "agent PASSED (7 tasks)" in out.stdout
 
 
 def test_multi_task_corpus_valid_under_fsm(tmp_path, monkeypatch):
@@ -93,6 +99,7 @@ def test_multi_task_corpus_valid_under_fsm(tmp_path, monkeypatch):
         json_constraint,
     )
     from opsagent_tpu.serving.tokenizer import ByteTokenizer
+    from opsagent_tpu.tools.jq import jq
     from opsagent_tpu.tools.kubectl import kubectl
     from opsagent_tpu.tools.python_tool import python_repl
     from opsagent_tpu.tools.replay import (
@@ -102,10 +109,10 @@ def test_multi_task_corpus_valid_under_fsm(tmp_path, monkeypatch):
 
     convs = build_convs(TASKS_MULTI)
     # Two convs per TRAINED phrasing (base instruction + all but the
-    # held-out alternative): 6 tasks x 4 phrasings x 2 turns.
+    # held-out alternative): 7 tasks x 4 phrasings x 2 turns.
     assert len(convs) == 2 * sum(
         len(train_phrasings(t)) for t in TASKS_MULTI
-    ) == 48
+    ) == 56
     con = json_constraint(ByteTokenizer(vocab_size=512), TOOLPROMPT_SCHEMA)
     for _, reply in convs:
         dfa = con.fsm.dfa
@@ -117,7 +124,7 @@ def test_multi_task_corpus_valid_under_fsm(tmp_path, monkeypatch):
     # as test_real_checkpoint.py's replay fixture).
     monkeypatch.setenv("PATH", os.environ["PATH"])
     install_replay_kubectl(MULTI_TASK_SCRIPT, str(tmp_path / "bin"))
-    tools = {"kubectl": kubectl, "python": python_repl}
+    tools = {"kubectl": kubectl, "python": python_repl, "jq": jq}
     for t in TASKS_MULTI:
         got = tools[t["tool"]](t["tool_input"])
         assert got == t["observation"], (t["tool_input"], got)
