@@ -1,0 +1,45 @@
+#!/bin/bash
+# Probe-then-bench loop (r04 lesson, VERDICT r04 #9): probe the tunneled
+# TPU GENTLY — 120 s cap, 10-min spacing; repeated hard kills of a
+# device-holding client can wedge the remote lease — and the moment the
+# chip answers, run the full measurement session so zero alive-time is
+# wasted. Runs tpu_measure.sh from a frozen worktree snapshot (WT) so
+# live-tree edits cannot race a mid-flight bench. One-shot: exits after
+# the first completed measurement session.
+set -u
+WT="${WT:-/root/repo/.bench_wt}"
+OUT="${OUT:-/root/repo/tpu_results_r05}"
+BUDGET="${OPSAGENT_BENCH_BUDGET:-2400}"
+mkdir -p "$OUT"
+LOG="$OUT/probe_loop.log"
+# Fail fast if the snapshot is missing (gitignored, created out-of-band
+# by `git worktree add`): discovering that at the moment the chip
+# finally answers would waste the whole alive window.
+if [ ! -x "$WT/scripts/tpu_measure.sh" ]; then
+  echo "$(date -u +%FT%TZ) FATAL: no measure script at $WT" >> "$LOG"
+  exit 1
+fi
+echo "$(date -u +%FT%TZ) probe loop start (wt=$WT budget=$BUDGET)" >> "$LOG"
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if timeout 120 python -c \
+    "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d" \
+    >> "$LOG" 2>&1; then
+    echo "$ts chip ALIVE -> measurement session" >> "$LOG"
+    OUT="$OUT" OPSAGENT_BENCH_BUDGET="$BUDGET" \
+      bash "$WT/scripts/tpu_measure.sh" >> "$LOG" 2>&1
+    rc=$?
+    echo "$(date -u +%FT%TZ) measurement session rc=$rc" >> "$LOG"
+    # One-shot only on a session that actually MEASURED something: a
+    # tunnel flap between the probe and the session's own probe exits
+    # nonzero with an empty jsonl — keep watching in that case, or the
+    # next alive window would find nothing listening (the r04 failure).
+    if [ "$rc" -eq 0 ] && [ -s "$OUT/bench.jsonl" ]; then
+      break
+    fi
+    echo "$(date -u +%FT%TZ) session incomplete; resuming probes" >> "$LOG"
+  else
+    echo "$ts unreachable; sleeping 600" >> "$LOG"
+  fi
+  sleep 600
+done
