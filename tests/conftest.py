@@ -110,6 +110,7 @@ SLOW_TESTS = (
     "test_bench_harness.py::test_wedged_child_killed_and_fallback_lands",
     "test_bench_harness.py::test_tiny_budget_goes_straight_to_fallback",
     "test_bench_harness.py::test_orchestrated_cpu_ends_with_headline_json",
+    "test_bench_harness.py::test_agent_mode_reports_per_turn_ttft_and_hit_rate",
     "test_trained_agent.py::test_train_serve_agent_roundtrip",
     "test_pipeline.py::test_pp2_",
     "test_pipeline.py::test_pp_remat_matches",
