@@ -608,6 +608,7 @@ def build_engine_app(stack: ServingStack):
                 "running": len(eng.sequences),
                 "prefix_hit_tokens": eng.alloc.hit_tokens,
                 "prefix_miss_tokens": eng.alloc.miss_tokens,
+                "prefix_evictions": eng.alloc.evictions,
             }
         )
 

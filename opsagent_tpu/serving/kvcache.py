@@ -82,6 +82,7 @@ class PageAllocator:
         self._clock = itertools.count()
         self.hit_tokens = 0   # cumulative prefix-cache hits (stats)
         self.miss_tokens = 0
+        self.evictions = 0    # cumulative trie-leaf evictions (stats)
 
     # -- queries -----------------------------------------------------------
     @property
@@ -151,6 +152,7 @@ class PageAllocator:
         return self._free.pop()
 
     def _evict(self, node: TrieNode) -> None:
+        self.evictions += 1
         del self._trie[(node.parent, node.key)]
         del self._by_page[node.page]
         if node.parent >= 0 and node.parent in self._by_page:

@@ -125,6 +125,7 @@ def test_http_completions_and_stream(stack):
             r = await client.get("/healthz")
             health = await r.json()
             assert health["status"] == "ok"
+            assert health["prefix_evictions"] >= 0  # counter exposed
 
             r = await client.post(
                 "/v1/chat/completions",

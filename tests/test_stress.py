@@ -134,3 +134,115 @@ def test_admissions_race_allocation_against_decode(engine):
     assert len(results) == 12 and all(len(r) >= 1 for r in results)
     assert engine.sequences == {}
     assert_conservation(engine)
+
+
+AGENT_TURNS = 3
+AGENT_PAGES = 40
+
+
+def test_agent_loop_prefix_reuse_under_pressure():
+    """The agent-loop shape under page pressure: racing multi-turn
+    sessions, each turn re-sending its grown history (prefix-trie
+    borrowing on every turn >= 2), on a DEDICATED tightly-sized engine
+    so the run does not depend on trie state other tests left behind.
+    Invariants: no page leaks at any point; the trie was actually HIT
+    (hit_tokens grew — a regression that silently disables matching
+    cannot stay green); eviction actually FIRED (a post-phase squeeze
+    prompt demands more pages than the free list holds, so the LRU
+    branch must run); and greedy outputs are IDENTICAL to a quiesced
+    serial replay of the same histories — trie hits, evictions, and
+    admission interleavings must never change what a session decodes
+    (the restart test's bit-identical guarantee, extended to
+    cross-session cache churn)."""
+    engine = Engine(EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+        num_pages=AGENT_PAGES, max_pages_per_seq=24, max_batch_size=4,
+        prefill_buckets=(8, 16), decode_block=4,
+    ))
+    sched = Scheduler(engine)
+    sched.start()
+    errors: list[str] = []
+    lock = threading.Lock()
+    recorded: dict[int, list[list[int]]] = {}
+
+    def histories(sid: int):
+        """Per-session deterministic inputs: the turn-1 prompt and one
+        observation-marker token per turn (appended after each reply to
+        grow the history, like the ReAct loop's tool observation)."""
+        rng = random.Random(900 + sid)
+        base = [257] + [rng.randint(1, 500) for _ in range(7)]
+        markers = [rng.randint(1, 500) for _ in range(AGENT_TURNS)]
+        return base, markers
+
+    def session(sid: int) -> None:
+        base, obs_markers = histories(sid)
+        history = list(base)
+        outs: list[list[int]] = []
+        for turn in range(AGENT_TURNS):
+            req = Request(list(history), SamplingParams(max_tokens=4))
+            sched.submit(req)
+            if not req.done.wait(180):
+                with lock:
+                    errors.append(f"s{sid} t{turn}: timeout")
+                return
+            if req.error:
+                with lock:
+                    errors.append(f"s{sid} t{turn}: {req.error}")
+                return
+            outs.append(list(req.tokens))
+            history += req.tokens + [obs_markers[turn]]
+            with engine.lock:
+                acc = engine.alloc.accounting()
+            if acc["total"] != AGENT_PAGES:
+                with lock:
+                    errors.append(f"s{sid} t{turn}: page leak {acc}")
+                return
+        with lock:
+            recorded[sid] = outs
+
+    threads = [
+        threading.Thread(target=session, args=(s,)) for s in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+            assert not t.is_alive(), "agent-loop stress session hung"
+    finally:
+        sched.stop()
+    assert errors == [], errors
+    assert sorted(recorded) == [0, 1, 2, 3]
+    assert engine.sequences == {}
+    assert engine.alloc.accounting()["total"] == AGENT_PAGES
+    # Turn >= 2 prompts extend turn-1 histories the trie has seen: reuse
+    # must have actually happened, not just produced correct output.
+    assert engine.alloc.hit_tokens > 0
+
+    # Deterministic eviction squeeze: 4 sessions x 6+ full pages donated
+    # > AGENT_PAGES - 22, so an 80-token prompt (20 pages + lookahead)
+    # cannot be served from the free list alone — the LRU eviction
+    # branch MUST run for this to succeed.
+    before = engine.alloc.evictions
+    squeeze_rng = random.Random(7)
+    squeeze = [257] + [squeeze_rng.randint(1, 500) for _ in range(79)]
+    out = engine.generate([squeeze], SamplingParams(max_tokens=2))[0]
+    assert len(out) >= 1
+    assert engine.alloc.evictions > before, "squeeze did not force eviction"
+    assert engine.alloc.accounting()["total"] == AGENT_PAGES
+
+    # Quiesced serial replay: same histories, no concurrency, whatever
+    # trie state survived the squeeze. Greedy outputs must match turn
+    # for turn.
+    for sid in range(4):
+        base, obs_markers = histories(sid)
+        history = list(base)
+        for turn in range(AGENT_TURNS):
+            out = engine.generate([history], SamplingParams(max_tokens=4))[0]
+            assert out == recorded[sid][turn], (
+                f"s{sid} t{turn}: concurrent {recorded[sid][turn]} "
+                f"!= serial {out}"
+            )
+            history += out + [obs_markers[turn]]
+    assert engine.sequences == {}
+    assert engine.alloc.accounting()["total"] == AGENT_PAGES
