@@ -50,6 +50,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from opsagent_tpu.utils.perf import get_perf_stats
+
 BASELINE_TOK_S_PER_CHIP = 2000.0  # BASELINE.json north_star decode target
 
 # The north-star target is defined for an 8B-class model on real TPU
@@ -70,6 +72,14 @@ def vs_baseline(tok_s_chip: float, model: str, platform: str) -> float | None:
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def log_perf_table() -> None:
+    """Per-phase engine series (prefill chunks, decode dispatches, ttft
+    — count/avg/p95/p99/max) to stderr: on chip this lands in
+    session.log and localizes first-call overhead (e.g. the r04 ~2 s
+    first-request TTFT) without a second instrumented run."""
+    log(get_perf_stats().format_table())
 
 
 def main() -> None:
@@ -561,6 +571,8 @@ def run_single() -> None:
     log(f"bench: {produced} tokens in {dt:.2f}s -> {tok_s:.0f} tok/s total, "
         f"{tok_s_chip:.0f} tok/s/chip; p50 TTFT {p50_ttft_ms:.0f} ms")
 
+    log_perf_table()
+
     qtag = f",{quantize}" if quantize else ""
     if kv_quantize:
         qtag += f",kv-{kv_quantize}"
@@ -653,8 +665,6 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
     ok = [r for r in results if "tokens" in r]
     produced = sum(r["tokens"] for r in ok)
     tok_s_chip = produced / wall / n_chips
-    from opsagent_tpu.utils.perf import get_perf_stats
-
     stats = get_perf_stats().get_stats()
     ttft = stats.get("engine.ttft", {})
     log(f"bench[sessions]: {batch} sessions x {rounds} rounds, "
@@ -679,6 +689,7 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
         },
     }), flush=True)
+    log_perf_table()
     stack.close()
 
 
@@ -701,7 +712,6 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
     import threading
 
     from opsagent_tpu.serving.api import ServingStack
-    from opsagent_tpu.utils.perf import get_perf_stats
 
     stack = ServingStack(eng)
     results: list[dict] = []   # one entry per completed turn
@@ -827,6 +837,7 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
     }), flush=True)
     if errors:
         log(f"bench[agent]: first error: {errors[0]}")
+    log_perf_table()
     stack.close()
 
 
