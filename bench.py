@@ -8,13 +8,14 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to nine stages in isolated
+A plain `python bench.py` orchestrates up to eleven stages in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
 the guaranteed number), then the bench-8b int8 headline, its int4,
 int8-KV-pages, and combined int4+int8-KV variants (the fastest 8B
 variant becomes the headline), the BASELINE config-5 concurrent-sessions
-run, the pallas-dma kernel comparison (plain and kv-int8), a
+run, the agent-turns stage (north-star p50 TTFT per tool-call turn),
+the pallas-dma kernel comparison (plain and kv-int8), a
 cold-restart TTFT probe against the stage-1-primed compilation cache,
 and last a speculative-decoding overhead run (its question is already
 measurement-closed).
