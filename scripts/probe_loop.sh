@@ -10,6 +10,10 @@ set -u
 WT="${WT:-/root/repo/.bench_wt}"
 OUT="${OUT:-/root/repo/tpu_results_r05}"
 BUDGET="${OPSAGENT_BENCH_BUDGET:-2400}"
+# Epoch seconds after which the loop must NOT hold the device: the
+# driver's end-of-round bench window needs the chip to itself (the r04
+# loop had the same guard). 0 disables.
+DEADLINE="${PROBE_DEADLINE:-0}"
 mkdir -p "$OUT"
 LOG="$OUT/probe_loop.log"
 # Fail fast if the snapshot is missing (gitignored, created out-of-band
@@ -22,11 +26,34 @@ fi
 echo "$(date -u +%FT%TZ) probe loop start (wt=$WT budget=$BUDGET)" >> "$LOG"
 while true; do
   ts=$(date -u +%FT%TZ)
+  if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "$ts deadline reached; exiting so the driver's bench window" \
+      "owns the device" >> "$LOG"
+    break
+  fi
   if timeout 120 python -c \
     "import jax; d = jax.devices(); assert d[0].platform == 'tpu', d" \
     >> "$LOG" 2>&1; then
-    echo "$ts chip ALIVE -> measurement session" >> "$LOG"
-    OUT="$OUT" OPSAGENT_BENCH_BUDGET="$BUDGET" \
+    budget="$BUDGET"
+    extras=""
+    if [ "$DEADLINE" -gt 0 ]; then
+      rem=$(( DEADLINE - $(date +%s) ))
+      if [ "$rem" -lt 1500 ]; then
+        echo "$ts chip alive but only ${rem}s before the deadline;" \
+          "leaving it for the driver" >> "$LOG"
+        break
+      fi
+      # Shrink to fit: the orchestrated stages get at most half the
+      # remaining window, and the profile/sweep extras are skipped
+      # unless the window absorbs their worst case ON TOP of the bench
+      # budget (probe 300 + profile cap 1500 + sweeps ~5x900 ≈ 6300s,
+      # rounded up — keep in step with tpu_measure.sh's stage list).
+      if [ $(( rem / 2 )) -lt "$budget" ]; then budget=$(( rem / 2 )); fi
+      if [ "$rem" -lt $(( budget + 6600 )) ]; then extras=1; fi
+    fi
+    echo "$ts chip ALIVE -> measurement session (budget ${budget}s" \
+      "skip_extras=${extras:-0})" >> "$LOG"
+    OUT="$OUT" OPSAGENT_BENCH_BUDGET="$budget" SKIP_EXTRAS="${extras}" \
       bash "$WT/scripts/tpu_measure.sh" >> "$LOG" 2>&1
     rc=$?
     echo "$(date -u +%FT%TZ) measurement session rc=$rc" >> "$LOG"

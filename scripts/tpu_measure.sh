@@ -24,6 +24,13 @@ echo "== staged bench (budget ${OPSAGENT_BENCH_BUDGET:-850}s) ==" | tee -a "$OUT
 python bench.py > "$OUT/bench.jsonl" 2> >(tee -a "$OUT/session.log" >&2)
 echo "bench rc=$?" | tee -a "$OUT/session.log"
 
+# SKIP_EXTRAS=1 (set by probe_loop.sh near its deadline): the staged
+# bench above is the decision matrix; the profile trace and sweep points
+# below are refinements a short window should not spend the chip on.
+if [ -n "${SKIP_EXTRAS:-}" ]; then
+  echo "== extras skipped (deadline window) ==" | tee -a "$OUT/session.log"
+else
+
 echo "== profiled 1B steady state ==" | tee -a "$OUT/session.log"
 # Generous cap: SIGTERM'ing a device-holding child wedges the remote lease
 # (r04 lesson) — the timeout exists only as a last-resort backstop, sized
@@ -57,6 +64,8 @@ sweep block64-kv   OPSAGENT_BENCH_BLOCK=64 OPSAGENT_BENCH_KV=int8
 # keeps weights + KV pages inside the 16 GB chip.
 sweep agent-8b     OPSAGENT_BENCH_MODE=agent OPSAGENT_BENCH_BATCH=8 \
                    OPSAGENT_BENCH_KV=int8
+
+fi  # SKIP_EXTRAS
 
 echo "results in $OUT:" | tee -a "$OUT/session.log"
 cat "$OUT/bench.jsonl"
