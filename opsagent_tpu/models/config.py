@@ -472,10 +472,11 @@ def get_config_preset(name: str) -> ModelConfig:
 
 def config_from_hf(path: str, name: str = "") -> ModelConfig:
     """Derive a ModelConfig from an HF checkpoint dir's ``config.json``
-    (model_type ``llama`` / ``qwen2`` / ``deepseek`` / ``deepseek_v2`` /
-    ``deepseek_v3`` — the dense, MoE, and MLA families this engine
-    serves), so ANY such HF checkpoint directory is servable without a
-    hand-written preset. The reference needs no model configs at all —
+    (model_type ``llama`` / ``mistral`` (non-sliding-window releases) /
+    ``qwen2`` / ``deepseek`` / ``deepseek_v2`` / ``deepseek_v3`` — the
+    dense, MoE, and MLA families this engine serves), so ANY such HF
+    checkpoint directory is servable without a hand-written preset.
+    The reference needs no model configs at all —
     its "model" is a remote API (reference pkg/llms/openai.go:69); here
     the checkpoint's own metadata is the source of truth. ``path`` may
     be the dir or the json file."""
@@ -488,10 +489,32 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
     with open(cfg_path, encoding="utf-8") as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "qwen2", "deepseek", "deepseek_v2", "deepseek_v3"):
+    if mt not in ("llama", "mistral", "qwen2", "deepseek", "deepseek_v2",
+                  "deepseek_v3"):
         raise ValueError(
-            f"config_from_hf supports model_type llama/qwen2/deepseek/"
-            f"deepseek_v2/deepseek_v3, got {mt!r}"
+            f"config_from_hf supports model_type llama/mistral/qwen2/"
+            f"deepseek/deepseek_v2/deepseek_v3, got {mt!r}"
+        )
+    # Sliding-window attention is not implemented; a config that would
+    # ACTIVELY use it must be rejected loudly, never silently served
+    # with full attention. Mistral (llama-shaped otherwise: same weight
+    # names, GQA, silu, RMSNorm): active when sliding_window is non-null
+    # and below the position window — only v0.1-class checkpoints;
+    # v0.2+/Nemo/Small ship sliding_window: null. Qwen2 carries the same
+    # fields but gates them with use_sliding_window (shipped Qwen2.5
+    # releases set it false).
+    sw = hf.get("sliding_window")
+    sw_active = sw is not None and int(sw) < int(
+        hf.get("max_position_embeddings", 8192)
+    )
+    if mt == "qwen2":
+        sw_active = sw_active and bool(hf.get("use_sliding_window", False))
+    if sw_active and not mt.startswith("deepseek"):
+        raise ValueError(
+            f"checkpoint uses ACTIVE sliding-window attention "
+            f"(sliding_window={sw}); this engine serves full paged "
+            f"attention only — use a release with the window disabled "
+            f"(sliding_window: null)"
         )
     moe = None
     mla = None

@@ -489,3 +489,77 @@ def test_hf_config_dict_preserves_attn_bias_on_moe():
             json.dump(hf, f)
         back = config_from_hf(d, name=cfg.name)
     assert back.attn_bias is True
+
+
+def test_hf_config_mistral_family(tmp_path):
+    """Mistral releases are llama-shaped (same weight names, GQA, silu)
+    once sliding-window attention is off: v0.3/Nemo-class configs
+    (sliding_window: null, explicit head_dim, rope_theta 1e6) must
+    derive; a v0.1-class ACTIVE window must be rejected loudly rather
+    than served with wrong (full) attention."""
+    from opsagent_tpu.models.config import config_from_hf
+
+    hf = {
+        "model_type": "mistral",
+        "architectures": ["MistralForCausalLM"],
+        "vocab_size": 32768,
+        "hidden_size": 64,
+        "intermediate_size": 128,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": 32,          # Nemo-style: explicit, != hidden/heads
+        "rope_theta": 1000000.0,
+        "rms_norm_eps": 1e-5,
+        "sliding_window": None,  # v0.3-class: window disabled
+        "max_position_embeddings": 32768,
+    }
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf, f)
+    cfg = config_from_hf(str(tmp_path))
+    assert not cfg.attn_bias          # mistral has no qkv biases
+    assert cfg.num_kv_heads == 2      # GQA preserved
+    assert cfg.head_dim == 32         # explicit head_dim honored
+    assert cfg.rope_theta == 1000000.0
+
+    # A window >= the position window is equivalent to disabled.
+    hf["sliding_window"] = 32768
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf, f)
+    assert config_from_hf(str(tmp_path)).num_layers == 2
+
+    # v0.1-class active window: reject, never silently full-attend.
+    hf["sliding_window"] = 4096
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf, f)
+    with pytest.raises(ValueError, match="sliding-window"):
+        config_from_hf(str(tmp_path))
+
+
+def test_hf_config_qwen2_sliding_window_gate(tmp_path):
+    """Qwen2 carries sliding_window fields gated by use_sliding_window:
+    false (every shipped Qwen2.5 release) must derive; true with an
+    active window must be rejected like mistral."""
+    from opsagent_tpu.models.config import config_from_hf
+
+    hf = {
+        "model_type": "qwen2",
+        "vocab_size": 1000,
+        "hidden_size": 64,
+        "intermediate_size": 128,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "max_position_embeddings": 32768,
+        "sliding_window": 4096,
+        "use_sliding_window": False,
+    }
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf, f)
+    assert config_from_hf(str(tmp_path)).num_layers == 2
+
+    hf["use_sliding_window"] = True
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(hf, f)
+    with pytest.raises(ValueError, match="sliding-window"):
+        config_from_hf(str(tmp_path))
