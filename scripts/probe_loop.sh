@@ -7,8 +7,9 @@
 # live-tree edits cannot race a mid-flight bench. One-shot: exits after
 # the first completed measurement session.
 set -u
-WT="${WT:-/root/repo/.bench_wt}"
-OUT="${OUT:-/root/repo/tpu_results_r05}"
+REPO="${REPO:-/root/repo}"
+WT="${WT:-$REPO/.bench_wt}"
+OUT="${OUT:-$REPO/tpu_results_r05}"
 BUDGET="${OPSAGENT_BENCH_BUDGET:-2400}"
 # Epoch seconds after which the loop must NOT hold the device: the
 # driver's end-of-round bench window needs the chip to itself (the r04
@@ -61,8 +62,23 @@ while true; do
     # tunnel flap between the probe and the session's own probe exits
     # nonzero with an empty jsonl — keep watching in that case, or the
     # next alive window would find nothing listening (the r04 failure).
-    if [ "$rc" -eq 0 ] && [ -s "$OUT/bench.jsonl" ]; then
-      break
+    if [ -s "$OUT/bench.jsonl" ]; then
+      # Results dirs are gitignored; mirror the artifacts to root-level
+      # committed names so the driver's end-of-round sweep preserves
+      # them even if no one is around to commit (r04's
+      # BENCH_r04_local.jsonl pattern). Monotonic by line count: a later
+      # session truncates $OUT/bench.jsonl at its start, so a partial
+      # rerun must never clobber a more complete earlier mirror.
+      new=$(wc -l < "$OUT/bench.jsonl")
+      old=0
+      [ -f "$REPO/BENCH_r05_local.jsonl" ] && \
+        old=$(wc -l < "$REPO/BENCH_r05_local.jsonl")
+      if [ "$new" -ge "$old" ]; then
+        cp "$OUT/bench.jsonl" "$REPO/BENCH_r05_local.jsonl"
+        [ -f "$OUT/session.log" ] && \
+          cp "$OUT/session.log" "$REPO/SESSION_r05.log"
+      fi
+      [ "$rc" -eq 0 ] && break
     fi
     echo "$(date -u +%FT%TZ) session incomplete; resuming probes" >> "$LOG"
   else
