@@ -116,6 +116,9 @@ class ModelConfig:
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
     attn_bias: bool = False          # Qwen2-style q/k/v biases
+    # Qwen3-style per-head RMSNorm on q and k (over head_dim, learned
+    # [head_dim] weights, applied before RoPE).
+    qk_norm: bool = False
     tie_embeddings: bool = False
     max_position: int = 131072
     moe: Optional[MoEConfig] = None
@@ -489,11 +492,11 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
     with open(cfg_path, encoding="utf-8") as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "qwen2", "deepseek", "deepseek_v2",
-                  "deepseek_v3"):
+    if mt not in ("llama", "mistral", "qwen2", "qwen3", "deepseek",
+                  "deepseek_v2", "deepseek_v3"):
         raise ValueError(
             f"config_from_hf supports model_type llama/mistral/qwen2/"
-            f"deepseek/deepseek_v2/deepseek_v3, got {mt!r}"
+            f"qwen3/deepseek/deepseek_v2/deepseek_v3, got {mt!r}"
         )
     # Sliding-window attention is not implemented; a config that would
     # ACTIVELY use it must be rejected loudly, never silently served
@@ -507,7 +510,7 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
     sw_active = sw is not None and int(sw) < int(
         hf.get("max_position_embeddings", 8192)
     )
-    if mt == "qwen2":
+    if mt in ("qwen2", "qwen3"):
         sw_active = sw_active and bool(hf.get("use_sliding_window", False))
     if sw_active and not mt.startswith("deepseek"):
         raise ValueError(
@@ -606,8 +609,10 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
         head_dim=mla.qk_head_dim if mla else int(hf.get("head_dim") or 0),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
-        # Qwen2 checkpoints carry q/k/v biases without an explicit flag.
+        # Qwen2 checkpoints carry q/k/v biases without an explicit flag;
+        # Qwen3 dropped the biases for per-head q/k RMSNorm instead.
         attn_bias=(mt == "qwen2") or bool(hf.get("attention_bias", False)),
+        qk_norm=(mt == "qwen3"),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
         max_position=int(hf.get("max_position_embeddings", 8192)),
         moe=moe,
@@ -643,16 +648,26 @@ def hf_config_dict(cfg: ModelConfig) -> dict:
     ``config_from_hf`` (checkpoint export). Dense configs emit
     llama/qwen2; MoE and/or MLA configs emit the deepseek family
     (deepseek_v2/v3 when MLA is present, deepseek otherwise)."""
+    if cfg.qk_norm and (cfg.moe or cfg.mla):
+        # No in-tree arch combines QK-norm with the deepseek config
+        # families; a silent deepseek export would drop qk_norm and
+        # desync the reloaded tree from the saved qn/kn weights.
+        raise ValueError(
+            "hf_config_dict cannot express qk_norm together with moe/mla"
+        )
     if cfg.mla:
         mt = ("deepseek_v3" if cfg.moe and cfg.moe.scoring_func == "sigmoid"
               else "deepseek_v2")
     elif cfg.moe:
         mt = "deepseek"
+    elif cfg.qk_norm:
+        mt = "qwen3"
     else:
         mt = "qwen2" if cfg.attn_bias else "llama"
     archs = {
         "llama": "LlamaForCausalLM",
         "qwen2": "Qwen2ForCausalLM",
+        "qwen3": "Qwen3ForCausalLM",
         "deepseek": "DeepseekForCausalLM",
         "deepseek_v2": "DeepseekV2ForCausalLM",
         "deepseek_v3": "DeepseekV3ForCausalLM",

@@ -118,6 +118,10 @@ def _build_tree(cfg: ModelConfig, ks, dtype, big, dense) -> Params:
             block["bq"] = jnp.zeros((L, q), dtype)
             block["bk"] = jnp.zeros((L, kv), dtype)
             block["bv"] = jnp.zeros((L, kv), dtype)
+        if cfg.qk_norm:
+            # Qwen3 per-head q/k RMSNorm weights (over head_dim).
+            block["qn"] = jnp.ones((L, cfg.head_dim_), dtype)
+            block["kn"] = jnp.ones((L, cfg.head_dim_), dtype)
         return block
 
     layers = attn_block(Ld)
@@ -280,6 +284,11 @@ def _attn_block_specs(cfg: ModelConfig) -> Params:
         block["bq"] = P(None, "tp")
         block["bk"] = P(None, "tp")
         block["bv"] = P(None, "tp")
+    if cfg.qk_norm:
+        # Per-head-dim vectors: the head axis shards over tp, head_dim
+        # does not — replicated.
+        block["qn"] = P(None, None)
+        block["kn"] = P(None, None)
     return block
 
 
@@ -488,11 +497,14 @@ def _qkv(
         q = q + lp["bq"]
         k = k + lp["bk"]
         v = v + lp["bv"]
-    return (
-        q.reshape(B, S, cfg.num_heads, D),
-        k.reshape(B, S, K, D),
-        v.reshape(B, S, K, D),
-    )
+    q = q.reshape(B, S, cfg.num_heads, D)
+    k = k.reshape(B, S, K, D)
+    if cfg.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim, BEFORE RoPE (the caller
+        # applies rope to this function's outputs).
+        q = rms_norm(q, lp["qn"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["kn"], cfg.rms_norm_eps)
+    return (q, k, v.reshape(B, S, K, D))
 
 
 def _qkv_mla(

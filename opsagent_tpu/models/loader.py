@@ -209,6 +209,9 @@ def _load_attn_block(
             block["bq"] = jnp.zeros((0, q), dtype)
             block["bk"] = jnp.zeros((0, kv), dtype)
             block["bv"] = jnp.zeros((0, kv), dtype)
+        if cfg.qk_norm:
+            block["qn"] = jnp.zeros((0, cfg.head_dim_), dtype)
+            block["kn"] = jnp.zeros((0, cfg.head_dim_), dtype)
         return block
 
     def linear(name_fmt: str) -> jnp.ndarray:
@@ -230,6 +233,10 @@ def _load_attn_block(
         block["bq"] = vector("model.layers.{}.self_attn.q_proj.bias")
         block["bk"] = vector("model.layers.{}.self_attn.k_proj.bias")
         block["bv"] = vector("model.layers.{}.self_attn.v_proj.bias")
+    if cfg.qk_norm:
+        # Qwen3 per-head q/k RMSNorm weights ([head_dim] each).
+        block["qn"] = vector("model.layers.{}.self_attn.q_norm.weight")
+        block["kn"] = vector("model.layers.{}.self_attn.k_norm.weight")
     return block
 
 
@@ -358,6 +365,8 @@ _ATTN_NAME_MAP = {
     "bq": "model.layers.{}.self_attn.q_proj.bias",
     "bk": "model.layers.{}.self_attn.k_proj.bias",
     "bv": "model.layers.{}.self_attn.v_proj.bias",
+    "qn": "model.layers.{}.self_attn.q_norm.weight",
+    "kn": "model.layers.{}.self_attn.k_norm.weight",
 }
 
 _DENSE_MLP_NAME_MAP = {

@@ -75,6 +75,26 @@ def make_qwen2():
     _golden(model, out_dir)
 
 
+def make_qwen3():
+    import torch
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(2)
+    # head_dim deliberately != hidden/heads (Qwen3 releases decouple
+    # them), exercising the explicit-head_dim path alongside QK-norm.
+    cfg = Qwen3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, rms_norm_eps=1e-6, rope_theta=10000.0,
+        max_position_embeddings=2048, tie_word_embeddings=False,
+        attention_bias=False, use_sliding_window=False,
+    )
+    model = Qwen3ForCausalLM(cfg).eval()
+    out_dir = os.path.join(HERE, "tiny-qwen3-hf")
+    model.save_pretrained(out_dir, safe_serialization=True)
+    _golden(model, out_dir)
+
+
 def _golden(model, out_dir):
     import torch
 
@@ -133,4 +153,5 @@ def make_deepseek_moe():
 if __name__ == "__main__":
     make_llama()
     make_qwen2()
+    make_qwen3()
     make_deepseek_moe()

@@ -32,6 +32,10 @@ TINY = get_config_preset("tiny-test")
 CASES = {
     "tiny-llama-hf": TINY,  # fixture mirrors the tiny-test architecture
     "tiny-qwen2-hf": replace(TINY, attn_bias=True, rms_norm_eps=1e-6),
+    # Qwen3: per-head q/k RMSNorm + explicit head_dim != hidden/heads.
+    "tiny-qwen3-hf": replace(
+        TINY, qk_norm=True, head_dim=32, rms_norm_eps=1e-6
+    ),
     "tiny-deepseek-moe": get_config_preset("tiny-moe"),
 }
 

@@ -563,3 +563,19 @@ def test_hf_config_qwen2_sliding_window_gate(tmp_path):
         json.dump(hf, f)
     with pytest.raises(ValueError, match="sliding-window"):
         config_from_hf(str(tmp_path))
+
+
+def test_hf_config_qwen3_family():
+    """Qwen3 derives with qk_norm on, no attn biases, and the explicit
+    head_dim honored — against the REAL fixture config.json transformers
+    wrote (tests/fixtures/tiny-qwen3-hf), not a hand-mocked dict."""
+    from opsagent_tpu.models.config import config_from_hf
+
+    path = os.path.join(REPO, "tests", "fixtures", "tiny-qwen3-hf")
+    if not os.path.isdir(path):
+        pytest.skip("qwen3 fixture not generated")
+    cfg = config_from_hf(path)
+    assert cfg.qk_norm
+    assert not cfg.attn_bias
+    assert cfg.head_dim == 32 and cfg.head_dim_ == 32
+    assert cfg.num_kv_heads == 2

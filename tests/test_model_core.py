@@ -193,3 +193,42 @@ def test_qwen_style_attn_bias():
     logits = llama.forward_full(p, cfg, tokens, dtype=DTYPE)
     assert logits.shape == (1, 4, 128)
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_qwen3_qk_norm_tp_sharded_matches_single_device():
+    """Qwen3-style per-head q/k RMSNorm (explicit head_dim != hidden/heads)
+    under tp=2: the replicated [head_dim] norm weights compose with
+    tp-sharded heads, and the sharded prefill must match single-device.
+    (HF numeric correctness is pinned separately by the tiny-qwen3-hf
+    golden fixture.)"""
+    from dataclasses import replace
+
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    cfg = replace(CFG, qk_norm=True, head_dim=32)
+    p = llama.init_params(cfg, jax.random.PRNGKey(3), dtype=DTYPE)
+    assert "qn" in p["layers"] and p["layers"]["qn"].shape[-1] == 32
+    mesh = make_mesh(tp=2, dp=4)
+    sharded = shard_params(p, llama.param_specs(cfg), mesh)
+    cache = llama.make_cache(cfg, num_pages=8, page_size=4, dtype=DTYPE)
+    cache_sharded = shard_params(cache, llama.cache_specs(cfg), mesh)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(8), (2, 8), 0, cfg.vocab_size
+    )
+    lengths = jnp.array([8, 6])
+    table = jnp.array([[0, 1, -1], [2, 3, -1]], jnp.int32)
+
+    ref_logits, _ = llama.prefill(
+        p, cfg, tokens, lengths, cache, table, dtype=DTYPE
+    )
+
+    @jax.jit
+    def run(pp, c):
+        return llama.prefill(
+            pp, cfg, tokens, lengths, c, table, dtype=DTYPE
+        )
+
+    with mesh:
+        tp_logits, _ = run(sharded, cache_sharded)
+    np.testing.assert_allclose(
+        np.asarray(tp_logits), np.asarray(ref_logits), rtol=2e-3, atol=2e-3
+    )
