@@ -492,11 +492,11 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
     with open(cfg_path, encoding="utf-8") as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "qwen2", "qwen3", "deepseek",
-                  "deepseek_v2", "deepseek_v3"):
+    if mt not in ("llama", "mistral", "qwen2", "qwen3", "qwen3_moe",
+                  "deepseek", "deepseek_v2", "deepseek_v3"):
         raise ValueError(
             f"config_from_hf supports model_type llama/mistral/qwen2/"
-            f"qwen3/deepseek/deepseek_v2/deepseek_v3, got {mt!r}"
+            f"qwen3/qwen3_moe/deepseek/deepseek_v2/deepseek_v3, got {mt!r}"
         )
     # Sliding-window attention is not implemented; a config that would
     # ACTIVELY use it must be rejected loudly, never silently served
@@ -510,7 +510,7 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
     sw_active = sw is not None and int(sw) < int(
         hf.get("max_position_embeddings", 8192)
     )
-    if mt in ("qwen2", "qwen3"):
+    if mt in ("qwen2", "qwen3", "qwen3_moe"):
         sw_active = sw_active and bool(hf.get("use_sliding_window", False))
     if sw_active and not mt.startswith("deepseek"):
         raise ValueError(
@@ -522,6 +522,32 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
     moe = None
     mla = None
     moe_layer_start = 0
+    if mt == "qwen3_moe":
+        # Qwen3-MoE uses the deepseek WEIGHT naming (mlp.gate router,
+        # mlp.experts.N.*_proj) with its own CONFIG key names and
+        # softmax-then-topk routing, no shared experts. Interleaved
+        # dense layers (decoder_sparse_step != 1 or mlp_only_layers)
+        # are not the contiguous dense-prefix layout this engine's
+        # stacked tree supports — reject rather than mis-route.
+        if hf.get("mlp_only_layers"):
+            raise ValueError(
+                "qwen3_moe mlp_only_layers interleaving is not supported"
+            )
+        if int(hf.get("decoder_sparse_step", 1) or 1) != 1:
+            raise ValueError(
+                "only decoder_sparse_step=1 (every layer MoE) is supported"
+            )
+        moe = MoEConfig(
+            num_experts=int(hf["num_experts"]),
+            num_experts_per_token=int(hf["num_experts_per_tok"]),
+            num_shared_experts=0,
+            expert_intermediate_size=int(hf["moe_intermediate_size"]),
+            norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+            routed_scaling_factor=1.0,
+            scoring_func="softmax",
+            n_group=1,
+            topk_group=1,
+        )
     if mt.startswith("deepseek"):
         if int(hf.get("moe_layer_freq", 1)) != 1:
             raise ValueError(
@@ -612,7 +638,7 @@ def config_from_hf(path: str, name: str = "") -> ModelConfig:
         # Qwen2 checkpoints carry q/k/v biases without an explicit flag;
         # Qwen3 dropped the biases for per-head q/k RMSNorm instead.
         attn_bias=(mt == "qwen2") or bool(hf.get("attention_bias", False)),
-        qk_norm=(mt == "qwen3"),
+        qk_norm=mt in ("qwen3", "qwen3_moe"),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
         max_position=int(hf.get("max_position_embeddings", 8192)),
         moe=moe,
@@ -646,16 +672,28 @@ def resolve_model(
 def hf_config_dict(cfg: ModelConfig) -> dict:
     """``config.json`` contents for a ModelConfig — the inverse of
     ``config_from_hf`` (checkpoint export). Dense configs emit
-    llama/qwen2; MoE and/or MLA configs emit the deepseek family
-    (deepseek_v2/v3 when MLA is present, deepseek otherwise)."""
-    if cfg.qk_norm and (cfg.moe or cfg.mla):
-        # No in-tree arch combines QK-norm with the deepseek config
-        # families; a silent deepseek export would drop qk_norm and
-        # desync the reloaded tree from the saved qn/kn weights.
+    llama/qwen2; qk_norm configs emit qwen3 (or qwen3_moe when paired
+    with a plain softmax MoE); other MoE and/or MLA configs emit the
+    deepseek family (deepseek_v2/v3 when MLA is present, deepseek
+    otherwise)."""
+    qwen3_moe = (
+        cfg.qk_norm and cfg.moe is not None and cfg.mla is None
+        and cfg.moe.scoring_func == "softmax"
+        and not cfg.moe.num_shared_experts
+        and cfg.moe.routed_scaling_factor == 1.0
+        and cfg.moe.n_group <= 1 and cfg.moe_layer_start == 0
+    )
+    if cfg.qk_norm and (cfg.moe or cfg.mla) and not qwen3_moe:
+        # QK-norm is only expressible in the qwen3/qwen3_moe families; a
+        # silent deepseek export would drop qk_norm and desync the
+        # reloaded tree from the saved qn/kn weights.
         raise ValueError(
-            "hf_config_dict cannot express qk_norm together with moe/mla"
+            "hf_config_dict cannot express qk_norm together with this "
+            "moe/mla configuration"
         )
-    if cfg.mla:
+    if qwen3_moe:
+        mt = "qwen3_moe"
+    elif cfg.mla:
         mt = ("deepseek_v3" if cfg.moe and cfg.moe.scoring_func == "sigmoid"
               else "deepseek_v2")
     elif cfg.moe:
@@ -668,6 +706,7 @@ def hf_config_dict(cfg: ModelConfig) -> dict:
         "llama": "LlamaForCausalLM",
         "qwen2": "Qwen2ForCausalLM",
         "qwen3": "Qwen3ForCausalLM",
+        "qwen3_moe": "Qwen3MoeForCausalLM",
         "deepseek": "DeepseekForCausalLM",
         "deepseek_v2": "DeepseekV2ForCausalLM",
         "deepseek_v3": "DeepseekV3ForCausalLM",
@@ -711,7 +750,17 @@ def hf_config_dict(cfg: ModelConfig) -> dict:
                 "mscale": rs.mscale,
                 "mscale_all_dim": rs.mscale_all_dim,
             }
-    if cfg.moe:
+    if cfg.moe and qwen3_moe:
+        m = cfg.moe
+        hf.update({
+            "num_experts": m.num_experts,
+            "num_experts_per_tok": m.num_experts_per_token,
+            "moe_intermediate_size": m.expert_intermediate_size,
+            "norm_topk_prob": m.norm_topk_prob,
+            "decoder_sparse_step": 1,
+            "mlp_only_layers": [],
+        })
+    elif cfg.moe:
         m = cfg.moe
         hf.update({
             "n_routed_experts": m.num_experts,

@@ -95,6 +95,28 @@ def make_qwen3():
     _golden(model, out_dir)
 
 
+def make_qwen3_moe():
+    import torch
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+    torch.manual_seed(3)
+    # Every layer MoE (decoder_sparse_step=1), softmax top-k routing with
+    # renormalization, no shared experts — the shipped Qwen3-MoE layout.
+    cfg = Qwen3MoeConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, rms_norm_eps=1e-6, rope_theta=10000.0,
+        max_position_embeddings=2048, tie_word_embeddings=False,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=96,
+        norm_topk_prob=True, decoder_sparse_step=1, mlp_only_layers=[],
+        use_sliding_window=False,
+    )
+    model = Qwen3MoeForCausalLM(cfg).eval()
+    out_dir = os.path.join(HERE, "tiny-qwen3-moe-hf")
+    model.save_pretrained(out_dir, safe_serialization=True)
+    _golden(model, out_dir)
+
+
 def _golden(model, out_dir):
     import torch
 
@@ -154,4 +176,5 @@ if __name__ == "__main__":
     make_llama()
     make_qwen2()
     make_qwen3()
+    make_qwen3_moe()
     make_deepseek_moe()

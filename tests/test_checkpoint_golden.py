@@ -36,8 +36,21 @@ CASES = {
     "tiny-qwen3-hf": replace(
         TINY, qk_norm=True, head_dim=32, rms_norm_eps=1e-6
     ),
+    # "auto" = derive the config from the fixture's own config.json via
+    # config_from_hf — the golden run then pins the WHOLE auto path
+    # (derivation + loader + forward) against the HF oracle.
+    "tiny-qwen3-moe-hf": "auto",
     "tiny-deepseek-moe": get_config_preset("tiny-moe"),
 }
+
+
+def _case_cfg(name, path):
+    cfg = CASES[name]
+    if cfg == "auto":
+        from opsagent_tpu.models.config import config_from_hf
+
+        cfg = config_from_hf(path)
+    return cfg
 
 
 def _fixture(name):
@@ -51,7 +64,7 @@ def _fixture(name):
 @pytest.mark.parametrize("name", sorted(CASES))
 def test_loader_forward_matches_golden_logits(name):
     path, golden = _fixture(name)
-    cfg = CASES[name]
+    cfg = _case_cfg(name, path)
     params = load_checkpoint(path, cfg, dtype=jnp.float32)
     prompt = golden["prompt"].tolist()
     logits = llama.forward_full(
@@ -69,7 +82,7 @@ def test_engine_generate_matches_golden_greedy(name):
     prefill -> paged block decode must reproduce the golden greedy
     continuation token for token."""
     path, golden = _fixture(name)
-    cfg = CASES[name]
+    cfg = _case_cfg(name, path)
     eng = Engine(
         EngineConfig(
             model="unused", checkpoint=path, dtype=jnp.float32, tp=1,

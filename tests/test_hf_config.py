@@ -579,3 +579,42 @@ def test_hf_config_qwen3_family():
     assert not cfg.attn_bias
     assert cfg.head_dim == 32 and cfg.head_dim_ == 32
     assert cfg.num_kv_heads == 2
+
+
+def test_hf_config_qwen3_moe_family(tmp_path):
+    """Qwen3-MoE derives from the real fixture config (qk_norm + softmax
+    top-k MoE, every layer sparse), roundtrips through hf_config_dict's
+    qwen3_moe export, and rejects the interleaved-dense layouts the
+    stacked tree cannot express."""
+    import dataclasses
+    import shutil
+
+    from opsagent_tpu.models.config import config_from_hf, hf_config_dict
+
+    src = os.path.join(REPO, "tests", "fixtures", "tiny-qwen3-moe-hf")
+    if not os.path.isdir(src):
+        pytest.skip("qwen3-moe fixture not generated")
+    cfg = config_from_hf(src)
+    assert cfg.qk_norm and cfg.moe is not None
+    assert cfg.moe.scoring_func == "softmax"
+    assert cfg.moe.num_shared_experts == 0
+    assert cfg.moe.norm_topk_prob
+    assert cfg.moe_layer_start == 0
+
+    out = hf_config_dict(cfg)
+    assert out["model_type"] == "qwen3_moe"
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(out, f)
+    back = config_from_hf(str(tmp_path), name=cfg.name)
+    assert dataclasses.asdict(back) == dataclasses.asdict(cfg)
+
+    # Interleaved dense layers: reject, the stacked tree is contiguous.
+    with open(os.path.join(src, "config.json")) as f:
+        hf = json.load(f)
+    hf["mlp_only_layers"] = [1]
+    bad = tmp_path / "interleaved"
+    bad.mkdir()
+    with open(bad / "config.json", "w") as f:
+        json.dump(hf, f)
+    with pytest.raises(ValueError, match="mlp_only_layers"):
+        config_from_hf(str(bad))
