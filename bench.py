@@ -83,6 +83,20 @@ def log_perf_table() -> None:
     log(get_perf_stats().format_table())
 
 
+def metrics_snapshot() -> dict:
+    """Compact dump of the obs registry (the same samples a GET /metrics
+    scrape would expose: TTFT/ITL histogram count+sum, decode-token and
+    dispatch counters, KV-page gauges), folded into every bench JSON line
+    so BENCH_*.json records engine telemetry alongside the latency
+    numbers."""
+    try:
+        from opsagent_tpu.obs import metrics_snapshot as snap
+
+        return snap()
+    except Exception:  # noqa: BLE001 - telemetry must never sink a bench
+        return {}
+
+
 def main() -> None:
     # Plain `python bench.py` orchestrates the presets in subprocesses
     # (guaranteed-fast number first, headline after, sessions last, all
@@ -595,6 +609,7 @@ def run_single() -> None:
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "decode_block": eng.cfg.decode_block,
             "page_size": eng.cfg.page_size,
+            "metrics": metrics_snapshot(),
         },
     }), flush=True)
 
@@ -688,6 +703,7 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
             "chips": n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": metrics_snapshot(),
         },
     }), flush=True)
     log_perf_table()
@@ -834,6 +850,7 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
             "chips": n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": metrics_snapshot(),
         },
     }), flush=True)
     if errors:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import re
 from typing import Any
 
+from .. import obs
 from ..llm.client import ChatClient
 from ..llm.tokens import constrict_messages, constrict_prompt, get_token_limits
 from ..tools import ToolPrompt, get_tools, ToolError
@@ -89,11 +90,22 @@ def assistant_with_config(
     tool history afterwards (as the execute handler does).
     """
     stop = trace_func("agent.loop")
+    # Span-tree root: reuse the caller's active trace (the execute handler
+    # roots one on the HTTP request ID); a direct CLI/library call gets its
+    # own request-scoped trace so llm_turn / tool_exec spans always land
+    # somewhere retrievable.
+    if obs.current_span() is not None:
+        import contextlib
+
+        tracer = contextlib.nullcontext()
+    else:
+        tracer = obs.trace_request(obs.new_request_id("agent"))
     try:
-        return _react_loop(
-            model, messages, max_tokens, count_tokens, verbose,
-            max_iterations, api_key, base_url,
-        )
+        with tracer:
+            return _react_loop(
+                model, messages, max_tokens, count_tokens, verbose,
+                max_iterations, api_key, base_url,
+            )
     finally:
         stop()
 
@@ -133,7 +145,11 @@ def _react_loop(
         response_format: dict[str, Any] | None = None,
     ) -> str:
         sendable = constrict_messages(msgs, model, max_tokens) if count_tokens else msgs
-        with ps.timer("agent.llm_turn"):
+        obs.AGENT_ITERATIONS.inc()
+        # The llm_turn span is the bridge into the engine: against the
+        # in-process tpu:// provider the frontend sees this as the current
+        # span and nests its generate/queue/prefill/decode children here.
+        with ps.timer("agent.llm_turn"), obs.span("llm_turn"):
             return client.chat(
                 model, max_tokens, sendable, response_format=response_format
             )
@@ -168,14 +184,18 @@ def _react_loop(
             if verbose:
                 log.info("tool %s input=%r", name, tool_input[:200])
             try:
-                with ps.timer(f"agent.tool.{name}"):
+                with ps.timer(f"agent.tool.{name}"), \
+                        obs.span("tool_exec", tool=name):
                     observation = tools[name](tool_input)
+                obs.TOOL_CALLS.inc(tool=name, outcome="ok")
             except ToolError as e:
+                obs.TOOL_CALLS.inc(tool=name, outcome="error")
                 observation = (
                     f"Tool {name} failed with error {e}. "
                     "Considering refine the inputs for the tool."
                 )
             except Exception as e:  # noqa: BLE001 - tool bugs become observations
+                obs.TOOL_CALLS.inc(tool=name, outcome="error")
                 observation = (
                     f"Tool {name} failed with error {e}. "
                     "Considering refine the inputs for the tool."

@@ -31,6 +31,11 @@ def _add_common(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--max-iterations", type=int, default=10)
     parser.add_argument("--api-key", default="", help="LLM API key (else env)")
     parser.add_argument("--base-url", default="", help="LLM base URL (else env)")
+    parser.add_argument(
+        "--metrics", action="store_true", default=False,
+        help="print the Prometheus /metrics exposition to stderr after "
+             "the run (same text a scrape of a server would return)",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -219,15 +224,21 @@ def main(argv: list[str] | None = None) -> int:
         from ..agent.react import assistant_with_config
         from ..workflows import assistant_flow
 
+        from .. import obs
+
         instructions = " ".join(args.instructions)
         messages = [
             {"role": "system", "content": REACT_SYSTEM_PROMPT},
             {"role": "user", "content": f"Here are the instructions: {instructions}"},
         ]
-        response, _ = assistant_with_config(
-            args.model, messages, args.max_tokens, args.count_tokens,
-            args.verbose, args.max_iterations, args.api_key, args.base_url,
-        )
+        # Root the request trace here so verbose runs can print the span
+        # summary afterwards (the ReAct loop would otherwise self-mint an
+        # ID this layer never learns).
+        with obs.trace_request(obs.new_request_id("cli")) as tr:
+            response, _ = assistant_with_config(
+                args.model, messages, args.max_tokens, args.count_tokens,
+                args.verbose, args.max_iterations, args.api_key, args.base_url,
+            )
         # Second LLM pass purely to reformat, as the reference does
         # (execute.go:280-281).
         try:
@@ -240,6 +251,9 @@ def main(argv: list[str] | None = None) -> int:
         print(render_markdown(result))
         if args.verbose:
             print(get_perf_stats().format_table(), file=sys.stderr)
+            print(obs.format_tree(tr.to_dict()), file=sys.stderr)
+        if args.metrics:
+            print(obs.metrics_text(), file=sys.stderr, end="")
         return 0
 
     if args.command == "analyze":
@@ -272,14 +286,21 @@ def main(argv: list[str] | None = None) -> int:
                 ),
             },
         ]
-        response, _ = assistant_with_config(
-            args.model, messages, args.max_tokens, args.count_tokens,
-            args.verbose, args.max_iterations, args.api_key, args.base_url,
-        )
+        from .. import obs
+
+        with obs.trace_request(obs.new_request_id("cli")) as tr:
+            response, _ = assistant_with_config(
+                args.model, messages, args.max_tokens, args.count_tokens,
+                args.verbose, args.max_iterations, args.api_key, args.base_url,
+            )
         from ..utils.jsonrepair import extract_field
 
         final = extract_field(response, "final_answer") or response
         print(render_markdown(final))
+        if args.verbose:
+            print(obs.format_tree(tr.to_dict()), file=sys.stderr)
+        if args.metrics:
+            print(obs.metrics_text(), file=sys.stderr, end="")
         return 0
 
     if args.command == "generate":

@@ -1,0 +1,134 @@
+"""Serving observability: Prometheus ``/metrics`` exposition, per-request
+trace spans, and the shared instrument handles the engine/scheduler/server
+layers record into.
+
+Import surface:
+
+- ``get_registry()`` / ``metrics_text()`` / ``metrics_snapshot()`` — the
+  process-wide metrics registry and its exposition/snapshot forms.
+- ``trace_request`` / ``span`` / ``current_span`` / ``get_trace`` — the
+  per-request span-tree API (obs/trace.py).
+- Module-level instrument handles (``TTFT_SECONDS`` etc.) — created once
+  at import; every layer records into the same child samples.
+
+The instrument names are the contract ``docs/observability.md`` documents;
+renaming one is a dashboard-breaking change.
+"""
+
+from __future__ import annotations
+
+from .metrics import (  # noqa: F401
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    escape_label_value,
+    get_registry,
+)
+from .trace import (  # noqa: F401
+    Span,
+    Trace,
+    current_span,
+    format_tree,
+    get_store,
+    get_trace,
+    new_request_id,
+    span,
+    trace_request,
+)
+
+_reg = get_registry()
+
+# -- engine step telemetry ----------------------------------------------------
+TTFT_SECONDS = _reg.histogram(
+    "opsagent_ttft_seconds",
+    "Time to first token per admitted request (admission to first sample)",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0),
+)
+ITL_SECONDS = _reg.histogram(
+    "opsagent_inter_token_latency_seconds",
+    "Latency between consecutive accepted tokens of one sequence",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5),
+)
+DECODE_TOKENS = _reg.counter(
+    "opsagent_decode_tokens_total", "Tokens produced by decode steps"
+)
+PREFILL_TOKENS = _reg.counter(
+    "opsagent_prefill_tokens_total", "Prompt tokens prefilled (cache misses)"
+)
+PREFIX_HIT_TOKENS = _reg.counter(
+    "opsagent_prefix_hit_tokens_total",
+    "Prompt tokens served from the prefix cache instead of prefill",
+)
+DECODE_DISPATCHES = _reg.counter(
+    "opsagent_decode_dispatches_total",
+    "Device decode dispatches by kind (block, single, speculative)",
+    labelnames=("kind",),
+)
+KV_PAGE_UTILIZATION = _reg.gauge(
+    "opsagent_kv_page_utilization",
+    "Fraction of KV-cache pages in use (0..1)",
+)
+KV_PAGES_FREE = _reg.gauge(
+    "opsagent_kv_pages_free", "KV-cache pages currently free"
+)
+BATCH_OCCUPANCY = _reg.gauge(
+    "opsagent_batch_occupancy",
+    "Running decode sequences over max_batch_size (0..1)",
+)
+RUNNING_SEQUENCES = _reg.gauge(
+    "opsagent_running_sequences", "Sequences the engine currently tracks"
+)
+PREEMPTIONS = _reg.counter(
+    "opsagent_preemptions_total",
+    "Sequences force-finished because the KV page budget ran out",
+)
+PREFIX_EVICTIONS = _reg.counter(
+    "opsagent_prefix_evictions_total", "Prefix-cache trie leaf evictions"
+)
+
+# -- request lifecycle --------------------------------------------------------
+ENGINE_REQUESTS = _reg.counter(
+    "opsagent_engine_requests_total",
+    "Engine generation requests by outcome",
+    labelnames=("outcome",),
+)
+QUEUE_WAIT_SECONDS = _reg.histogram(
+    "opsagent_queue_wait_seconds",
+    "Scheduler admission queue wait per request",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0),
+)
+HTTP_REQUESTS = _reg.counter(
+    "opsagent_http_requests_total",
+    "HTTP requests by method, path, and status",
+    labelnames=("method", "path", "status"),
+)
+HTTP_LATENCY_SECONDS = _reg.histogram(
+    "opsagent_http_request_duration_seconds",
+    "HTTP request wall time by path",
+    labelnames=("path",),
+)
+AGENT_ITERATIONS = _reg.counter(
+    "opsagent_agent_iterations_total", "ReAct loop iterations"
+)
+TOOL_CALLS = _reg.counter(
+    "opsagent_agent_tool_calls_total",
+    "Agent tool invocations by tool and outcome",
+    labelnames=("tool", "outcome"),
+)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def metrics_text() -> str:
+    """The exposition document for a GET /metrics scrape."""
+    return get_registry().render()
+
+
+def metrics_snapshot() -> dict:
+    """Compact dict of every sample (bench.py folds this into BENCH
+    JSON)."""
+    return get_registry().snapshot()

@@ -10,10 +10,12 @@ the JWT middleware (pkg/middleware/jwt.go).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Awaitable, Callable
 
 from aiohttp import web
 
+from .. import obs
 from ..utils.globalstore import get_global
 from ..utils.logger import get_logger
 from ..utils.perf import get_perf_stats
@@ -33,7 +35,7 @@ _CORS_HEADERS = {
 
 Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 
-PUBLIC_PATHS = {"/login", "/api/version", "/healthz"}
+PUBLIC_PATHS = {"/login", "/api/version", "/healthz", "/metrics"}
 
 
 @web.middleware
@@ -58,15 +60,32 @@ async def recovery_middleware(request: web.Request, handler: Handler) -> web.Str
 
 @web.middleware
 async def logging_middleware(request: web.Request, handler: Handler) -> web.StreamResponse:
+    # Request ID at ingress: honor a client/proxy-supplied X-Request-Id,
+    # mint one otherwise. Handlers root their trace span tree on it
+    # (handlers.execute), and the response echoes it so clients can fetch
+    # GET /api/trace/{id} afterwards.
+    rid = request.headers.get("X-Request-Id") or obs.new_request_id()
+    request["request_id"] = rid
     perf = get_perf_stats()
+    t0 = time.perf_counter()
     with perf.timer(f"http.{request.method}.{request.path}"):
         resp = await handler(request)
+    dt = time.perf_counter() - t0
+    status = getattr(resp, "status", 0)
+    obs.HTTP_REQUESTS.inc(
+        method=request.method, path=request.path, status=str(status)
+    )
+    obs.HTTP_LATENCY_SECONDS.observe(dt, path=request.path)
+    try:
+        resp.headers["X-Request-Id"] = rid
+    except Exception:  # noqa: BLE001 - prepared stream responses
+        pass
     log.info(
         "%s %s -> %d",
         request.method,
         request.path,
-        getattr(resp, "status", 0),
-        extra={"fields": {"remote": request.remote}},
+        status,
+        extra={"fields": {"remote": request.remote, "request_id": rid}},
     )
     return resp
 
@@ -114,6 +133,8 @@ def build_app() -> web.Application:
     app.router.add_post("/api/analyze", handlers.analyze)
     app.router.add_get("/api/perf/stats", handlers.perf_stats)
     app.router.add_post("/api/perf/reset", handlers.perf_reset)
+    app.router.add_get("/metrics", handlers.metrics)
+    app.router.add_get("/api/trace/{request_id}", handlers.trace_get)
     return app
 
 

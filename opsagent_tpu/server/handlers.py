@@ -11,12 +11,13 @@ implemented for real over the workflow/agent layer.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 from typing import Any
 
 from aiohttp import web
 
-from .. import VERSION
+from .. import VERSION, obs
 from ..agent.prompts import DIAGNOSE_SYSTEM_PROMPT, EXECUTE_SYSTEM_PROMPT_CN
 from ..agent.react import assistant_with_config
 from ..llm.client import ChatClient, LLMError
@@ -181,10 +182,16 @@ async def execute(request: web.Request) -> web.Response:
             {"role": "system", "content": EXECUTE_SYSTEM_PROMPT_CN},
             {"role": "user", "content": instructions},
         ]
-        try:
-            response, history = await asyncio.get_running_loop().run_in_executor(
-                None,
-                lambda: assistant_with_config(
+        # Root the request's span tree on the ingress request ID (minted
+        # by logging_middleware) and run the agent INSIDE that context:
+        # run_in_executor does not propagate contextvars by itself, so the
+        # worker thread gets an explicit copy — the ReAct loop's llm_turn
+        # and tool_exec spans land under this root.
+        rid = request.get("request_id") or obs.new_request_id()
+
+        def run_traced() -> tuple[str, list[dict[str, Any]]]:
+            with obs.trace_request(rid):
+                return assistant_with_config(
                     model,
                     messages,
                     SERVER_MAX_TOKENS,
@@ -193,15 +200,23 @@ async def execute(request: web.Request) -> web.Response:
                     SERVER_MAX_ITERATIONS,
                     api_key,
                     base_url,
-                ),
+                )
+
+        ctx = contextvars.copy_context()
+        try:
+            response, history = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: ctx.run(run_traced)
             )
         except LLMError as e:
             return web.json_response(
-                {"error": f"agent failed: {e}", "status": "error"}, status=500
+                {"error": f"agent failed: {e}", "status": "error",
+                 "request_id": rid},
+                status=500,
             )
         tools_history = _tools_history(history)
         with perf.timer("execute_response_parse"):
             data = _parse_agent_response(response, tools_history, show_thought)
+        data["request_id"] = rid
         return web.json_response(data)
     finally:
         stop()
@@ -276,7 +291,7 @@ async def diagnose(request: web.Request) -> web.Response:
     return web.json_response(data)
 
 
-# -- perf -------------------------------------------------------------------
+# -- perf / observability -----------------------------------------------------
 async def perf_stats(request: web.Request) -> web.Response:
     return web.json_response({"stats": get_perf_stats().get_stats()})
 
@@ -284,3 +299,21 @@ async def perf_stats(request: web.Request) -> web.Response:
 async def perf_reset(request: web.Request) -> web.Response:
     get_perf_stats().reset()
     return web.json_response({"status": "reset"})
+
+
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus text-format exposition (the serving engine mounts its
+    own /metrics; co-hosted deployments scrape either — one process-wide
+    registry)."""
+    return web.Response(
+        text=obs.metrics_text(), content_type="text/plain", charset="utf-8"
+    )
+
+
+async def trace_get(request: web.Request) -> web.Response:
+    """The span tree of one request (by the X-Request-Id echoed on every
+    response / the request_id field of execute responses)."""
+    t = obs.get_trace(request.match_info["request_id"])
+    if t is None:
+        return web.json_response({"error": "unknown request_id"}, status=404)
+    return web.json_response(t)

@@ -22,6 +22,7 @@ import time
 import uuid
 from typing import Any
 
+from .. import obs
 from ..llm.client import register_provider
 from ..utils.jsonrepair import parse_json
 from ..utils.logger import get_logger
@@ -292,7 +293,33 @@ class ServingStack:
         return out
 
     # -- chat.completions ---------------------------------------------------
+    def _request_trace(
+        self,
+    ) -> tuple[obs.Trace | None, "obs.Span | None", str]:
+        """Trace context for one chat completion: nest under the caller's
+        current span when one is active (the in-process tpu:// path — the
+        ReAct loop's ``llm_turn`` span), otherwise root a NEW trace whose
+        request ID doubles as the OpenAI completion id, so
+        ``GET /api/trace/<completion id>`` finds it. Returns
+        (owned_trace_or_None, parent_span, completion_id)."""
+        parent = obs.current_span()
+        if parent is not None:
+            return None, parent, f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        t = obs.Trace(obs.new_request_id("chatcmpl"))
+        obs.get_store().add(t)
+        return t, t.root, t.request_id
+
     def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
+        owned, parent, cid = self._request_trace()
+        try:
+            return self._chat_completion_traced(body, parent, cid)
+        finally:
+            if owned is not None:
+                owned.finish()
+
+    def _chat_completion_traced(
+        self, body: dict[str, Any], parent: "obs.Span", cid: str
+    ) -> dict[str, Any]:
         sampling, prompt_ids, mask_fn = self._translate(body)
         try:
             n = int(body.get("n", 1) or 1)
@@ -309,25 +336,39 @@ class ServingStack:
         mask_fns = [mask_fn] + [
             self._constraint_from(body) for _ in range(n - 1)
         ]
+        spans = [
+            parent.start_child("generate", choice=i) if parent is not None
+            else None
+            for i in range(n)
+        ]
         reqs = [
-            Request(list(prompt_ids), sampling, mask_fn=mask_fns[i])
+            Request(
+                list(prompt_ids), sampling, mask_fn=mask_fns[i],
+                trace=spans[i],
+            )
             for i in range(n)
         ]
         for r in reqs:
             self.scheduler.submit(r)
         deadline = time.time() + 600
-        for r in reqs:
-            if not r.done.wait(max(0.0, deadline - time.time())):
-                raise TimeoutError("generation timed out")
+        try:
+            for r in reqs:
+                if not r.done.wait(max(0.0, deadline - time.time())):
+                    raise TimeoutError("generation timed out")
+        finally:
+            for i, s in enumerate(spans):
+                if s is not None:
+                    s.close(tokens=len(reqs[i].tokens))
         errs = [r for r in reqs if r.error]
         if errs:
             raise RequestError(errs[0].error, errs[0].error_status)
-        choices = [
-            self._build_choice(i, r, sampling) for i, r in enumerate(reqs)
-        ]
+        with obs.span("detokenize", parent=parent):
+            choices = [
+                self._build_choice(i, r, sampling) for i, r in enumerate(reqs)
+            ]
         total_completion = sum(len(r.tokens) for r in reqs)
         return {
-            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "id": cid,
             "object": "chat.completion",
             "created": int(t0),
             "model": body.get("model") or self.model_name,
@@ -410,12 +451,17 @@ class ServingStack:
         if n != 1:
             raise RequestError("n > 1 is not supported with stream", 400)
         token_q: "queue.Queue[int | None]" = queue.Queue()
+        owned, parent, cid = self._request_trace()
+        gen_span = (
+            parent.start_child("generate", stream=True)
+            if parent is not None else None
+        )
         req = Request(
-            prompt_ids, sampling, mask_fn=mask_fn, on_token=lambda t: token_q.put(t)
+            prompt_ids, sampling, mask_fn=mask_fn,
+            on_token=lambda t: token_q.put(t), trace=gen_span,
         )
         self.scheduler.submit(req)
         created = int(time.time())
-        cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         model = body.get("model") or self.model_name
         eos = self.engine.tokenizer.eos_id
         sent: list[int] = []
@@ -431,6 +477,20 @@ class ServingStack:
                 ],
             }
 
+        try:
+            yield from self._stream_events(
+                req, token_q, chunk, sampling, eos, sent
+            )
+        finally:
+            # Close the trace no matter how the stream ends (client
+            # disconnect raises GeneratorExit here): the span tree stays
+            # retrievable at /api/trace/{cid} with whatever phases ran.
+            if gen_span is not None:
+                gen_span.close(tokens=len(sent))
+            if owned is not None:
+                owned.finish()
+
+    def _stream_events(self, req, token_q, chunk, sampling, eos, sent):
         watchdog = threading.Thread(
             target=lambda: (req.done.wait(600), token_q.put(None)), daemon=True
         )
@@ -711,10 +771,37 @@ def build_engine_app(stack: ServingStack):
             )
         return web.json_response({"status": "stopped"})
 
+    async def metrics(request: web.Request) -> web.Response:
+        # Freshen the engine gauges at scrape time: an idle engine's last
+        # step may be minutes old, but page residency (held sessions,
+        # prefix-cache content) changes meanwhile.
+        eng = stack.engine
+        try:
+            with eng.lock:
+                eng._observe_occupancy()
+        except AttributeError:
+            pass  # test fakes without the full engine surface
+        return web.Response(
+            text=obs.metrics_text(),
+            content_type="text/plain",
+            charset="utf-8",
+            headers={"X-Prometheus-Version": "0.0.4"},
+        )
+
+    async def trace_get(request: web.Request) -> web.Response:
+        t = obs.get_trace(request.match_info["request_id"])
+        if t is None:
+            return web.json_response(
+                {"error": {"message": "unknown request_id"}}, status=404
+            )
+        return web.json_response(t)
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", completions)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/api/trace/{request_id}", trace_get)
     app.router.add_post("/v1/profile/start", profile_start)
     app.router.add_post("/v1/profile/stop", profile_stop)
     return app

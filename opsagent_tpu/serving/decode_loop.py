@@ -33,6 +33,26 @@ from ..models.config import ModelConfig
 from .sampler import sample
 
 
+def record_dispatch(kind: str, rows: int, steps: int) -> None:
+    """Host-side dispatch telemetry for the decode programs in this
+    module. The loop bodies themselves are jitted — their Python runs only
+    at trace time, so instrumentation inside them would count compiles,
+    not dispatches. The engine calls this once per enqueued program:
+    ``kind`` is "block" (decode_block_carry), "spec"
+    (speculative_block_carry), or "single" (the fused one-step path);
+    ``rows`` is how many lanes got a budget and ``steps`` the largest
+    per-lane budget in the dispatch."""
+    from .. import obs
+
+    obs.DECODE_DISPATCHES.inc(kind=kind)
+    if rows > 0 and steps > 0:
+        obs.get_registry().histogram(
+            "opsagent_decode_dispatch_rows",
+            "Budgeted lanes per decode dispatch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+        ).observe(rows)
+
+
 def decode_block(
     params: Any,
     cfg: ModelConfig,

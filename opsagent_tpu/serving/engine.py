@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..models import llama
 from ..models.config import ModelConfig, get_config_preset
 from ..parallel.mesh import make_mesh, shard_params
@@ -196,6 +197,13 @@ class Sequence:
     # maintained by _accept_token so penalized long generations stay
     # O(distinct tokens) per step instead of re-counting the history.
     penalty_counts: dict | None = None
+    # Observability (obs.trace): the request's span handle under which the
+    # engine records prefill/decode phase children, the open decode span,
+    # and the previous accepted-token timestamp for the inter-token-latency
+    # histogram. All optional — untraced traffic pays one None check.
+    trace: Any = None
+    decode_span: Any = None
+    last_tok_s: float = 0.0
 
 
 class Engine:
@@ -355,6 +363,7 @@ class Engine:
             prefix_cache=cfg.prefix_cache,
         )
         self.sequences: dict[int, Sequence] = {}
+        self._evictions_seen = 0  # delta-sync base for the obs counter
         self._sample_key = jax.random.PRNGKey(cfg.seed + 1)
 
         mc, dt = self.model_cfg, cfg.dtype
@@ -791,6 +800,7 @@ class Engine:
         sampling: SamplingParams | None = None,
         mask_fn: Callable[[list[int]], np.ndarray] | None = None,
         stream: Callable[[int], None] | None = None,
+        trace: Any = None,
     ) -> int:
         """Admit a request synchronously: allocate pages, run the whole
         prefill, sample the first token. Returns the sequence id. Raises
@@ -801,7 +811,9 @@ class Engine:
         decode blocks instead of stalling every running stream for the
         whole admission (VERDICT round-1 weak #7)."""
         with self.lock:
-            seq_id = self.begin_request(prompt_ids, sampling, mask_fn, stream)
+            seq_id = self.begin_request(
+                prompt_ids, sampling, mask_fn, stream, trace=trace
+            )
             while not self.prefill_step(seq_id):
                 pass
             return seq_id
@@ -812,6 +824,7 @@ class Engine:
         sampling: SamplingParams | None = None,
         mask_fn: Callable[[list[int]], np.ndarray] | None = None,
         stream: Callable[[int], None] | None = None,
+        trace: Any = None,
     ) -> int:
         """Stage 1 of admission: allocate pages (reusing any cached prefix)
         and register the sequence in the 'prefilling' state. Cheap — no
@@ -848,6 +861,7 @@ class Engine:
             seq = Sequence(
                 seq_id, n, prompt_ids=list(prompt_ids),
                 params=sampling, mask_fn=mask_fn, stream=stream,
+                trace=trace,
             )
             self.sequences[seq_id] = seq
             self._prefilling[seq_id] = matched
@@ -855,6 +869,8 @@ class Engine:
                 get_perf_stats().record_metric(
                     "engine.prefix_hit_tokens", matched, "tok"
                 )
+                obs.PREFIX_HIT_TOKENS.inc(matched)
+            self._observe_occupancy()
             return seq_id
 
     def next_prefill_bucket(self, seq_id: int) -> int:
@@ -929,6 +945,7 @@ class Engine:
                 perf.record_metric(
                     "engine.prefill_tokens", int(sum(chunks)), "tok"
                 )
+                obs.PREFILL_TOKENS.inc(int(sum(chunks)))
                 out: dict[int, Any] = {}
                 finished_rows = [
                     i for i, (seq, d, c) in enumerate(zip(seqs, dones, chunks))
@@ -978,6 +995,7 @@ class Engine:
                     token = int(first_toks[i])
                     seq.ttft_s = time.perf_counter() - seq.started_s
                     perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
+                    self._first_token_obs(seq)
                     try:
                         self._accept_token(seq, token)
                     except Exception as e:  # noqa: BLE001 - stream callback
@@ -985,6 +1003,7 @@ class Engine:
                         out[sid] = e
                         continue
                     out[sid] = True
+                self._observe_occupancy()
                 return out
             except Exception:
                 for sid in seq_ids:
@@ -1045,6 +1064,7 @@ class Engine:
                 done += chunk
                 perf = get_perf_stats()
                 perf.record_metric("engine.prefill_tokens", chunk, "tok")
+                obs.PREFILL_TOKENS.inc(chunk)
                 if done < n:
                     self._prefilling[seq_id] = done
                     return False
@@ -1052,7 +1072,9 @@ class Engine:
                 token = int(self._sample_one(logits, [seq])[0])
                 seq.ttft_s = time.perf_counter() - seq.started_s
                 perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
+                self._first_token_obs(seq)
                 self._accept_token(seq, token)
+                self._observe_occupancy()
                 return True
             except Exception:
                 # Failed admissions (prefill OOM, raising mask_fn, a raising
@@ -1198,8 +1220,44 @@ class Engine:
                 )
         return toks
 
+    # -- observability -------------------------------------------------------
+    def _observe_occupancy(self) -> None:
+        """Refresh the engine-step gauges (KV page utilization, batch
+        occupancy) and delta-sync the allocator's cumulative prefix-trie
+        eviction count into the obs counter. Cheap host math — called from
+        admission, step, and finish paths under the engine lock."""
+        free = self.alloc.free_pages
+        obs.KV_PAGES_FREE.set(free)
+        obs.KV_PAGE_UTILIZATION.set(1.0 - free / max(1, self.alloc.num_pages))
+        running = sum(1 for s in self.sequences.values() if not s.done)
+        obs.BATCH_OCCUPANCY.set(running / max(1, self.cfg.max_batch_size))
+        obs.RUNNING_SEQUENCES.set(len(self.sequences))
+        ev = self.alloc.evictions
+        if ev > self._evictions_seen:
+            obs.PREFIX_EVICTIONS.inc(ev - self._evictions_seen)
+            self._evictions_seen = ev
+
+    def _first_token_obs(self, seq: Sequence) -> None:
+        """Prefill finished and the first token was sampled: observe TTFT,
+        record the prefill span, and open the request's decode span (per-
+        dispatch block spans attach under it; closed when the sequence
+        finishes)."""
+        obs.TTFT_SECONDS.observe(seq.ttft_s)
+        now = time.perf_counter()
+        if seq.trace is not None:
+            seq.trace.child(
+                "prefill", seq.started_s, now,
+                prompt_tokens=seq.prompt_len,
+            )
+            seq.decode_span = seq.trace.start_child("decode")
+
     def _accept_token(self, seq: Sequence, token: int) -> None:
         seq.tokens.append(token)
+        obs.DECODE_TOKENS.inc()
+        now = time.perf_counter()
+        if seq.last_tok_s:
+            obs.ITL_SECONDS.observe(now - seq.last_tok_s)
+        seq.last_tok_s = now
         p = seq.params
         if p.presence_penalty or p.frequency_penalty:
             if seq.penalty_counts is None:
@@ -1218,6 +1276,11 @@ class Engine:
         elif seq.params.stop and self._hit_stop_string(seq):
             seq.done = True
             seq.finish_reason = "stop"
+        if seq.done and seq.decode_span is not None:
+            seq.decode_span.close(
+                tokens=len(seq.tokens), finish_reason=seq.finish_reason
+            )
+            seq.decode_span = None
 
     def _hit_stop_string(self, seq: Sequence) -> bool:
         """Check the decoded tail for any stop string, so generation halts at
@@ -1261,7 +1324,7 @@ class Engine:
         round trip per dispatch) and fold them into host state. Records are
         pulled FIFO, so the host always sees a row's EOS before any of its
         later pad-only blocks."""
-        toks_d, lane_seqs, budgets, counts_d = self._inflight.popleft()
+        toks_d, lane_seqs, budgets, counts_d, t_disp = self._inflight.popleft()
         perf = get_perf_stats()
         t0 = time.perf_counter()
         toks = np.asarray(toks_d)
@@ -1284,6 +1347,10 @@ class Engine:
             if s is None or s.done:
                 continue  # finished/vanished while this block was in flight
             n0 = len(s.tokens)
+            # The open decode span, captured BEFORE the accept loop can
+            # close it (EOS mid-block): the block's span child must attach
+            # to the span that was live while the block ran.
+            dspan = s.decode_span
             try:
                 if counts is None:
                     for j in range(int(budgets[lane])):
@@ -1321,6 +1388,14 @@ class Engine:
                 accepted = s.tokens[n0:]
                 out[sid] = accepted
                 produced += len(accepted)
+                if dspan is not None and accepted:
+                    # Span per pulled block: dispatch -> pull. Blocks of
+                    # one sequence overlap under pipeline_depth > 0, which
+                    # is the point — the trace shows the pipelining.
+                    dspan.child(
+                        "decode_block", t_disp, time.perf_counter(),
+                        tokens=len(accepted),
+                    )
                 if s.done:
                     # Roll pre-booked pages back to written content. Any
                     # still-in-flight dispatch may keep writing to the freed
@@ -1345,6 +1420,7 @@ class Engine:
                     if self.alloc.length(sid) > keep:
                         self.alloc.truncate(sid, keep)
         perf.record_metric("engine.decode_tokens", produced, "tok")
+        self._observe_occupancy()
         if first_exc is not None:
             raise first_exc
         return out
@@ -1390,6 +1466,7 @@ class Engine:
                     except OutOfPages:
                         s.done = True
                         s.finish_reason = "length"
+                        obs.PREEMPTIONS.inc()
                         log.warning(
                             "seq %d truncated: KV page budget exhausted",
                             s.seq_id,
@@ -1426,6 +1503,7 @@ class Engine:
             bias = self._bias_array(slots, B)
             want_lp = any(s.params.logprobs for s in running)
             chosen_lp = top_ids = top_lps = None
+            t_step = time.perf_counter()
             with self.mesh_ctx():
                 # split under the mesh like warmup's, or its eager helper
                 # programs recompile on the first serving-window call.
@@ -1454,10 +1532,14 @@ class Engine:
                 else:
                     sampled, self.cache = self._decode_sample_jit(*args)
             sampled = np.asarray(sampled)
+            from .decode_loop import record_dispatch
+
+            record_dispatch("single", rows=len(running), steps=1)
             out: dict[int, int] = {}
             first_exc: BaseException | None = None
             for i, s in enumerate(running):
                 tok = int(sampled[i])
+                dspan = s.decode_span
                 if s.params.logprobs:
                     n = s.params.top_logprobs
                     s.logprob_data.append({
@@ -1482,7 +1564,12 @@ class Engine:
                 # an errored sequence's token is in seq.tokens (and in what
                 # finish() returns) — report it, matching _pull_oldest.
                 out[s.seq_id] = tok
+                if dspan is not None:
+                    dspan.child(
+                        "decode_step", t_step, time.perf_counter(), tokens=1
+                    )
             get_perf_stats().record_metric("engine.decode_tokens", len(running), "tok")
+            self._observe_occupancy()
             if first_exc is not None:
                 raise first_exc
             return out
@@ -1663,6 +1750,7 @@ class Engine:
                 if got == 0:
                     s.done = True
                     s.finish_reason = "length"
+                    obs.PREEMPTIONS.inc()
                     self.alloc.truncate(sid, self._host_written(s))
                     self._free_lane(sid)
                     override[lane] = False
@@ -1803,7 +1891,14 @@ class Engine:
                 # Observability for the speculative path (also the signal
                 # tests use to prove speculation actually engaged).
                 perf.record_metric("engine.spec_blocks", 1, "blk")
-            self._inflight.append((toks, lane_seqs, budgets, counts))
+            from .decode_loop import record_dispatch
+
+            record_dispatch(
+                "spec" if speculate else "block",
+                rows=int(np.count_nonzero(budgets)),
+                steps=int(budgets.max()),
+            )
+            self._inflight.append((toks, lane_seqs, budgets, counts, t_disp))
             for sid, b in zip(lane_seqs, budgets):
                 if sid is not None and b:
                     self._inflight_steps[sid] = (
@@ -1842,6 +1937,14 @@ class Engine:
         with self.lock:
             seq = self.sequences.pop(seq_id)
             self.alloc.free(seq_id, tokens=seq.prompt_ids + seq.tokens[:-1])
+            if seq.decode_span is not None:
+                # Aborted/errored sequences can reach finish() with the
+                # decode span still open.
+                seq.decode_span.close(
+                    tokens=len(seq.tokens), finish_reason=seq.finish_reason
+                )
+                seq.decode_span = None
+            self._observe_occupancy()
             return seq.tokens
 
     # -- convenience (tests / bench) ----------------------------------------

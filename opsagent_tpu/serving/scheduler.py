@@ -18,6 +18,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from .. import obs
 from ..utils.logger import get_logger
 from ..utils.perf import get_perf_stats
 from .engine import Engine
@@ -58,6 +59,12 @@ class Request:
     # at reap when sampling.logprobs was requested; accumulates across
     # engine restarts like generated_prefix.
     logprob_data: list[dict] = field(default_factory=list)
+    # Observability: the request's span handle (obs.trace.Span). The
+    # scheduler thread has no ambient contextvar from the submitting
+    # thread, so the span rides the Request explicitly; queue-wait is
+    # recorded here and the handle is passed into engine.begin_request
+    # for the prefill/decode phase children.
+    trace: Any = None
 
     def __post_init__(self) -> None:
         self.done = threading.Event()
@@ -152,6 +159,7 @@ class Scheduler:
                 continue
             if now - req.enqueued_s > self.admission_timeout_s:
                 req.error = "admission timed out (engine saturated)"
+                obs.ENGINE_REQUESTS.inc(outcome="timeout")
                 req.done.set()
                 continue
             try:
@@ -160,6 +168,7 @@ class Scheduler:
                     req.sampling,
                     mask_fn=req.mask_fn,
                     stream=req.on_token,
+                    trace=req.trace,
                 )
             except OutOfPages:
                 # Transient: pages will free as running sequences finish.
@@ -184,9 +193,13 @@ class Scheduler:
                 continue
             req.seq_id = seq_id
             self._prefilling[seq_id] = req
+            wait_s = now - req.enqueued_s
             get_perf_stats().record_metric(
-                "scheduler.queue_wait", (now - req.enqueued_s) * 1e3, "ms"
+                "scheduler.queue_wait", wait_s * 1e3, "ms"
             )
+            obs.QUEUE_WAIT_SECONDS.observe(wait_s)
+            if req.trace is not None:
+                req.trace.child("queue_wait", req.enqueued_s, now)
         self._waiting = still
 
     def _advance_prefill(self) -> None:
@@ -231,6 +244,7 @@ class Scheduler:
         req.error = f"admission failed: {e}"
         if isinstance(e, (InvalidRequest, PromptTooLong)):
             req.error_status = 400
+        obs.ENGINE_REQUESTS.inc(outcome="admission_failed")
         req.done.set()
 
     def _reap(self) -> None:
@@ -249,6 +263,9 @@ class Scheduler:
                 # callback (client went away mid-stream). Only THIS request
                 # fails; the rest of the batch keeps decoding.
                 req.error = "stream callback failed"
+            obs.ENGINE_REQUESTS.inc(
+                outcome="error" if req.error else "completed"
+            )
             req.done.set()
 
     def _recover(self) -> None:

@@ -7,39 +7,65 @@ Capability parity with the reference's pkg/utils/perf.go (singleton perf.go:33,
 timers perf.go:64-139, aggregation perf.go:168-210, HTTP accessors
 perf.go:296-335). On the TPU side this registry also carries the serving
 engine's first-class gauges (tokens/sec/chip, TTFT; SURVEY.md section 5).
+The richer Prometheus-facing instruments live in ``opsagent_tpu.obs``;
+this registry is bridged into that exposition (obs/metrics.py) so
+``/metrics`` and ``/api/perf/stats`` tell one story.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Any, Callable, Iterator
 
+# Per-series sample window. Sustained traffic used to grow every series
+# without bound (the old list held every observation forever — at ~1 kB/s
+# of floats per busy series that is an OOM on a long-lived server); the
+# window bounds memory while count/avg/min/max stay exact via running
+# aggregates. Percentiles are computed over the window, i.e. they are
+# RECENT percentiles — the more useful flavor for a serving dashboard
+# anyway (a p99 dominated by hour-old warmup samples is noise).
+SERIES_WINDOW = 4096
+
 
 class _Series:
-    __slots__ = ("values", "unit")
+    __slots__ = ("values", "unit", "count", "total", "vmin", "vmax")
 
-    def __init__(self, unit: str = "ms") -> None:
-        self.values: list[float] = []
+    def __init__(self, unit: str = "ms", window: int = SERIES_WINDOW) -> None:
+        self.values: deque[float] = deque(maxlen=window)
         self.unit = unit
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+
+    def add(self, value: float) -> None:
+        self.values.append(value)
+        self.count += 1
+        self.total += value
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
 
     def summary(self) -> dict[str, Any]:
+        if self.count == 0:
+            return {"count": 0, "unit": self.unit}
         vs = sorted(self.values)
         n = len(vs)
-        if n == 0:
-            return {"count": 0, "unit": self.unit}
 
         def pct(p: float) -> float:
             idx = min(n - 1, max(0, int(round(p * (n - 1)))))
             return vs[idx]
 
         return {
-            "count": n,
+            "count": self.count,
             "unit": self.unit,
-            "min": vs[0],
-            "max": vs[-1],
-            "avg": sum(vs) / n,
+            "min": self.vmin,
+            "max": self.vmax,
+            "avg": self.total / self.count,
             "p50": pct(0.50),
             "p95": pct(0.95),
             "p99": pct(0.99),
@@ -59,6 +85,10 @@ class PerfStats:
         self.enabled = True
 
     # -- timers ------------------------------------------------------------
+    # One recording path for every timer flavor: start/stop pairs, the
+    # ``timer`` context manager, and ``trace_func`` all end in
+    # ``record_metric(name, elapsed_ms, "ms")``, so the aggregation,
+    # enable gating, and units cannot drift between entry points.
     def start_timer(self, name: str) -> None:
         if not self.enabled:
             return
@@ -74,9 +104,9 @@ class PerfStats:
             if not stack:
                 return 0.0
             t0 = stack.pop()
-            ms = (now - t0) * 1e3
-            self._series.setdefault(name, _Series("ms")).values.append(ms)
-            return ms
+        ms = (now - t0) * 1e3
+        self.record_metric(name, ms, "ms")
+        return ms
 
     @contextmanager
     def timer(self, name: str) -> Iterator[None]:
@@ -91,7 +121,7 @@ class PerfStats:
         if not self.enabled:
             return
         with self._lock:
-            self._series.setdefault(name, _Series(unit)).values.append(value)
+            self._series.setdefault(name, _Series(unit)).add(value)
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -108,10 +138,14 @@ class PerfStats:
             return out
 
     def reset(self) -> None:
+        """Clear aggregated series and gauges. In-flight ``start_timer``
+        stacks are deliberately KEPT: a reset landing mid-request used to
+        orphan the open timer (its ``stop_timer`` found an empty stack and
+        silently recorded nothing); now the pair still completes and lands
+        in the post-reset window."""
         with self._lock:
             self._series.clear()
             self._gauges.clear()
-            self._active.clear()
 
     def format_table(self) -> str:
         stats = self.get_stats()

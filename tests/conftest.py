@@ -25,6 +25,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from opsagent_tpu import obs  # noqa: E402
 from opsagent_tpu.llm import client as llm_client  # noqa: E402
 from opsagent_tpu import tools as tools_pkg  # noqa: E402
 from opsagent_tpu.utils.globalstore import clear_globals  # noqa: E402
@@ -92,9 +93,16 @@ def fake_tools():
 def clean_state():
     clear_globals()
     get_perf_stats().reset()
+    # Observability isolation: clear the metric SAMPLES (instruments stay
+    # registered) and the trace ring, so count assertions see only their
+    # own test's traffic.
+    obs.get_registry().reset()
+    obs.get_store().clear()
     yield
     clear_globals()
     get_perf_stats().reset()
+    obs.get_registry().reset()
+    obs.get_store().clear()
 
 
 # -- fast/slow lanes ---------------------------------------------------------
