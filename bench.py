@@ -8,14 +8,15 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to eleven stages in isolated
+A plain `python bench.py` orchestrates up to twelve stages in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
 the guaranteed number), then the bench-8b int8 headline, its int4,
 int8-KV-pages, and combined int4+int8-KV variants (the fastest 8B
 variant becomes the headline), the BASELINE config-5 concurrent-sessions
-run, the agent-turns stage (north-star p50 TTFT per tool-call turn),
-the pallas-dma kernel comparison (plain and kv-int8), a
+run, the sessions-mixed A/B (mixed prefill+decode batching on vs. off on
+the same workload), the agent-turns stage (north-star p50 TTFT per
+tool-call turn), the pallas-dma kernel comparison (plain and kv-int8), a
 cold-restart TTFT probe against the stage-1-primed compilation cache,
 and last a speculative-decoding overhead run (its question is already
 measurement-closed).
@@ -34,6 +35,10 @@ BASELINE config-5 scenario: ``batch`` concurrent client sessions
 submitting chat completions through the full stack (OpenAI translation
 -> scheduler admission -> chunked prefill -> pipelined decode),
 reporting aggregate tok/s/chip and the p50 TTFT clients observed.
+OPSAGENT_BENCH_MODE=sessions-mixed runs that same workload TWICE against
+one engine — mixed prefill+decode batching on, then off — and reports
+both (the one-weight-stream-per-tick delta); OPSAGENT_BENCH_MIXED=0
+pins the split tick for any other mode.
 OPSAGENT_BENCH_MODE=agent runs the north-star agent shape instead:
 multi-turn ReAct sessions (observation-as-user-message, full-history
 resend) with the prefix cache on, reporting p50 client TTFT per
@@ -184,8 +189,9 @@ def run_orchestrated() -> None:
     Order: default preset (bench-1b on TPU, tiny-test elsewhere — the
     guaranteed number), then the bench-8b int8 headline and its int4,
     int8-KV, and combined int4+int8-KV variants, the BASELINE config-5
-    concurrent-sessions run, the pallas-dma kernel comparisons, the
-    cold-restart TTFT probe, and the speculative-decoding overhead run
+    concurrent-sessions run, the sessions-mixed A/B, the agent-turns
+    stage, the pallas-dma kernel comparisons, the cold-restart TTFT
+    probe, and the speculative-decoding overhead run
     last; the later stages only start if the
     remaining budget plausibly covers them. Mode/spec env vars are
     stripped from stages
@@ -207,6 +213,7 @@ def run_orchestrated() -> None:
         "OPSAGENT_PAGED_BACKEND": None,
         "OPSAGENT_BENCH_QUANT": None,
         "OPSAGENT_BENCH_KV": None,
+        "OPSAGENT_BENCH_MIXED": None,
     }
 
     def stage(env_extra: dict, min_remaining: float, tag: str,
@@ -296,6 +303,15 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         240, "sessions",
     ) if on_tpu else None
+    # Mixed-batching A/B on the sessions workload: the same config-5
+    # scenario run with the unified mixed prefill+decode tick and with
+    # the split tick in ONE child, so the one-weight-stream-per-tick
+    # delta (tok/s and p50 TTFT) lands as a first-class BENCH artifact.
+    rsessmix = stage(
+        {"OPSAGENT_BENCH_MODE": "sessions-mixed",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        240, "sessions-mixed",
+    ) if on_tpu else None
     # The literal north-star metric (BASELINE: p50 TTFT per tool-call
     # turn): multi-turn ReAct-shaped sessions with the prefix cache on.
     # Reports ms, not tok/s — never a headline candidate; folded into
@@ -371,6 +387,12 @@ def run_orchestrated() -> None:
         extra["sessions_p50_ttft_ms"] = rsess.get("extra", {}).get(
             "p50_ttft_ms"
         )
+    if rsessmix is not None:
+        me = rsessmix.get("extra", {})
+        extra["sessions_mixed_tok_s_chip"] = rsessmix["value"]
+        extra["sessions_mixed_p50_ttft_ms"] = me.get("p50_ttft_ms")
+        extra["sessions_split_tok_s_chip"] = me.get("split_tok_s_chip")
+        extra["sessions_split_p50_ttft_ms"] = me.get("split_p50_ttft_ms")
     if ragent is not None:
         ae = ragent.get("extra", {})
         extra["agent_turn_p50_ttft_ms"] = ragent["value"]
@@ -428,10 +450,14 @@ def run_single() -> None:
     # (decode_block x (pipeline_depth + 1) tokens are pre-booked).
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
-    if mode in ("sessions", "agent"):
+    if mode in ("sessions", "agent", "sessions-mixed"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
+    # Mixed prefill+decode batching (EngineConfig.mixed_batching):
+    # OPSAGENT_BENCH_MIXED=0 pins the split prefill/decode tick; the
+    # sessions-mixed stage measures both in one child.
+    mixed_on = os.environ.get("OPSAGENT_BENCH_MIXED", "") != "0"
     kv_quantize = os.environ.get("OPSAGENT_BENCH_KV", "")
     # Page geometry, overridable for on-chip sweeps: the XLA gather reads
     # the FULL page-table capacity (max_pages x page_size) per step
@@ -481,6 +507,7 @@ def run_single() -> None:
         kv_quantize=kv_quantize,
         speculative_k=spec_k,
         decode_block=decode_block,
+        mixed_batching=mixed_on,
     )
     # Fail fast on undersized sweep points: OutOfPages mid-window would
     # force-finish sequences ('length') and quietly deflate the metric.
@@ -512,7 +539,7 @@ def run_single() -> None:
     # full-stack path as sessions (scheduler admission -> chunked prefill
     # -> pipelined decode), so it shares that warmup level.
     t0 = time.perf_counter()
-    if mode in ("sessions", "agent"):
+    if mode in ("sessions", "agent", "sessions-mixed"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -525,6 +552,10 @@ def run_single() -> None:
     if mode == "sessions":
         run_sessions(eng, model, batch, steps, prompt_len, platform,
                      n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "sessions-mixed":
+        run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
+                           n_chips, quantize, init_s, warmup_s)
         return
     if mode == "agent":
         # turns/gen_tokens are THE values the page-budget guard above was
@@ -710,6 +741,143 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
     stack.close()
 
 
+def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
+                              seed_base: int) -> dict:
+    """Run ``batch`` concurrent multi-round chat sessions with STREAMING
+    completions, measuring client-observed TTFT per round (first yielded
+    chunk, error-checked). Returns {produced, wall, ttfts, errors} —
+    self-contained client-side measurement, so two phases in one process
+    cannot contaminate each other through global perf-stat snapshots."""
+    import threading
+
+    results: list[dict] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def session(sid: int) -> None:
+        rng = np.random.default_rng(seed_base + sid)
+        words = [f"w{rng.integers(0, 9999)}" for _ in range(prompt_len // 2)]
+        messages = [
+            {"role": "system", "content": "bench session"},
+            {"role": "user", "content": " ".join(words)},
+        ]
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            try:
+                gen = stack.chat_completion_stream({
+                    "messages": messages,
+                    "max_tokens": gen_tokens,
+                    "temperature": 0.0,
+                    "stream": True,
+                })
+                first = next(gen)
+                if "error" in first:
+                    raise RuntimeError(first["error"]["message"])
+                ttft = time.perf_counter() - t0
+                parts: list[str] = []
+                n_tok = 0
+                for ch in gen:
+                    if "error" in ch:
+                        raise RuntimeError(ch["error"]["message"])
+                    delta = ch["choices"][0]["delta"]
+                    if delta.get("content"):
+                        parts.append(delta["content"])
+                        n_tok += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"round {r + 1}: {e}")
+                return
+            messages.append(
+                {"role": "assistant", "content": "".join(parts)}
+            )
+            messages.append({"role": "user", "content": f"continue {r}"})
+            with lock:
+                results.append({"ttft": ttft, "tokens": n_tok})
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=session, args=(i,)) for i in range(batch)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {
+        "produced": sum(r["tokens"] for r in results),
+        "wall": time.perf_counter() - t0,
+        "ttfts": [r["ttft"] for r in results],
+        "errors": errors,
+    }
+
+
+def run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
+                       n_chips, quantize, init_s, warmup_s) -> None:
+    """The mixed-batching A/B stage: the BASELINE config-5 concurrent-
+    sessions workload run TWICE against the same engine — once with the
+    unified mixed prefill+decode tick (one weight stream per tick), once
+    with the split prefill-then-decode tick — so the delta is a
+    first-class BENCH artifact, not a cross-round comparison. Distinct
+    prompt seeds per phase keep phase 2 from riding phase 1's prefix
+    cache. Reports the mixed numbers as the headline value with the split
+    phase in extra."""
+    from opsagent_tpu.serving.api import ServingStack
+
+    gen_tokens = max(16, steps // 8)
+    rounds = 3
+    phases: dict[str, dict] = {}
+    for tag, flag, seed in (("mixed", True, 5000), ("split", False, 9000)):
+        eng.cfg.mixed_batching = flag
+        stack = ServingStack(eng)
+        try:
+            phases[tag] = _drive_sessions_streaming(
+                stack, batch, rounds, gen_tokens, prompt_len, seed
+            )
+        finally:
+            stack.close()
+        r = phases[tag]
+        p50 = float(np.median(r["ttfts"]) * 1e3) if r["ttfts"] else 0.0
+        r["p50_ttft_ms"] = p50
+        r["p99_ttft_ms"] = (
+            float(np.percentile(r["ttfts"], 99) * 1e3) if r["ttfts"] else 0.0
+        )
+        r["tok_s_chip"] = r["produced"] / max(1e-9, r["wall"]) / n_chips
+        log(f"bench[sessions-mixed/{tag}]: {batch} sessions x {rounds} "
+            f"rounds, {r['produced']} tokens in {r['wall']:.2f}s -> "
+            f"{r['tok_s_chip']:.0f} tok/s/chip; p50 TTFT {p50:.0f} ms; "
+            f"errors={len(r['errors'])}")
+    mixed, split = phases["mixed"], phases["split"]
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": f"sessions_mixed[{model}{qtag},N={batch},{platform}]",
+        "value": round(mixed["tok_s_chip"], 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": vs_baseline(mixed["tok_s_chip"], model, platform),
+        "extra": {
+            "sessions": batch,
+            "rounds": rounds,
+            "p50_ttft_ms": round(mixed["p50_ttft_ms"], 1),
+            "p99_ttft_ms": round(mixed["p99_ttft_ms"], 1),
+            "split_tok_s_chip": round(split["tok_s_chip"], 1),
+            "split_p50_ttft_ms": round(split["p50_ttft_ms"], 1),
+            "split_p99_ttft_ms": round(split["p99_ttft_ms"], 1),
+            "ttft_delta_ms": round(
+                split["p50_ttft_ms"] - mixed["p50_ttft_ms"], 1
+            ),
+            "tok_s_chip_delta": round(
+                mixed["tok_s_chip"] - split["tok_s_chip"], 1
+            ),
+            "errors": len(mixed["errors"]) + len(split["errors"]),
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
+            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": metrics_snapshot(),
+        },
+    }), flush=True)
+    log_perf_table()
+
+
 def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
                     quantize, init_s, warmup_s, turns: int,
                     gen_tokens: int) -> None:
@@ -735,7 +903,11 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
     errors: list[str] = []
     lock = threading.Lock()
     tok = eng.tokenizer
-    hit0 = eng.alloc.hit_tokens
+    # Snapshot through stack.engine (the scheduler's CURRENT engine), not
+    # the local ``eng``: a mid-bench slice-restart rebuild swaps in a
+    # fresh allocator, and diffing the dead engine's frozen counter would
+    # silently zero the reported hit rate (ADVICE r05).
+    hit0 = stack.engine.alloc.hit_tokens
     pre0 = get_perf_stats().get_stats().get("engine.prefill_tokens", {})
     prefill0 = pre0.get("count", 0) * pre0.get("avg", 0.0)
 
@@ -766,8 +938,13 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
                 gen = stack.chat_completion_stream(body)
                 # The first yielded chunk (role delta) is gated on the
                 # engine's first real token, so time-to-first-yield IS the
-                # client-observed TTFT.
-                next(gen)
+                # client-observed TTFT — but ONLY for a successful turn: a
+                # failed request also yields its error payload promptly,
+                # and recording that as TTFT would count an errored turn
+                # as a fast success (ADVICE r05).
+                first = next(gen)
+                if "error" in first:
+                    raise RuntimeError(first["error"]["message"])
                 ttft = time.perf_counter() - t0
                 parts: list[str] = []
                 for ch in gen:
@@ -819,7 +996,7 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
     # trie-borrowed tokens; engine.prefill_tokens counts what was actually
     # prefilled (the misses). hits / (hits + misses) = the hit rate the
     # agent loop achieved.
-    hits = eng.alloc.hit_tokens - hit0
+    hits = stack.engine.alloc.hit_tokens - hit0
     pre1 = get_perf_stats().get_stats().get("engine.prefill_tokens", {})
     prefilled = pre1.get("count", 0) * pre1.get("avg", 0.0) - prefill0
     hit_rate = hits / max(1.0, hits + prefilled)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import (
+    paged_ragged_attention_auto,
     causal_prefill_attention,
     paged_decode_attention_auto,
     paged_prefix_attention,
@@ -978,6 +979,64 @@ def prefill_with_prefix(
     x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.clip(lengths - 1, 0, S - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    logits = _lm_head(params, cfg, x_last)
+    return logits, cache
+
+
+def mixed_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B, S] int32 ragged rows, right-padded
+    start: jax.Array,        # [B] tokens already in cache (write offset)
+    q_lens: jax.Array,       # [B] valid row lengths (0 = inactive row)
+    cache: Params,
+    page_table: jax.Array,   # [B, MaxP]
+    dtype: jnp.dtype = jnp.bfloat16,
+    attn_impl: str = "xla",  # ops.paged_attention_backend choice
+    mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
+) -> tuple[jax.Array, Params]:
+    """The unified mixed prefill+decode forward: one program advances
+    q_len=1 decode rows AND q_len=chunk prefill rows in the same batch, so
+    chunked prefill rides the decode dispatch's weight stream instead of
+    buying its own (the engine's ``step_mixed``; Sarathi-style
+    piggybacking over Ragged Paged Attention, PAPERS.md). Same math as
+    ``prefill_with_prefix`` — per-row write offset, causal attention
+    inside the chunk over paged cache — but attention goes through the
+    impl-dispatched ragged op (Pallas page streaming on TPU when enabled)
+    and rows with q_lens == 0 are inert (no KV writes; garbage logits the
+    caller discards). Returns (last-valid-position logits [B, V],
+    updated cache)."""
+    B, S = tokens.shape
+    positions = start[:, None] + jnp.arange(S)[None, :]
+    cos, sin = rope_table(positions, cfg.rope_dim_, cfg.rope_theta,
+                          scaling=cfg.rope_scaling)
+    x = params["embed"][tokens].astype(dtype)
+
+    def attn_fn(h, lp, kc, vc, li):
+        if _latent_cache(cfg):
+            q_lat, latent = _mla_latent_parts(h, lp, cfg, cos, sin)
+            kc = write_pages(
+                kc, latent, page_table, start, valid_len=q_lens, layer=li
+            )
+            ctx = paged_ragged_attention_auto(
+                q_lat, kc, kc, page_table, start, q_lens,
+                impl=attn_impl, layer=li, mesh=mesh,
+            )
+            return _mla_latent_out(ctx, lp, cfg), kc, vc
+        q, k, v = _qkv_rope(h, lp, cfg, cos, sin)
+        kc, vc = write_kv_pages(
+            kc, vc, k, v, page_table, start, valid_len=q_lens, layer=li
+        )
+        attn = paged_ragged_attention_auto(
+            q, kc, vc, page_table, start, q_lens,
+            impl=attn_impl, layer=li, mesh=mesh,
+        )
+        return attn.reshape(B, S, -1), kc, vc
+
+    x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.clip(q_lens - 1, 0, S - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, cfg, x_last)
     return logits, cache

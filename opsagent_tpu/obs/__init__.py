@@ -65,8 +65,23 @@ PREFIX_HIT_TOKENS = _reg.counter(
 )
 DECODE_DISPATCHES = _reg.counter(
     "opsagent_decode_dispatches_total",
-    "Device decode dispatches by kind (block, single, speculative)",
+    "Device decode dispatches by kind (block, single, speculative, mixed)",
     labelnames=("kind",),
+)
+MIXED_DECODE_LANES = _reg.histogram(
+    "opsagent_mixed_dispatch_decode_lanes",
+    "Decode lanes advanced per mixed prefill+decode dispatch",
+    buckets=(0, 1, 2, 4, 8, 16, 32, 64),
+)
+MIXED_PREFILL_TOKENS = _reg.histogram(
+    "opsagent_mixed_dispatch_prefill_tokens",
+    "Prefill chunk tokens piggybacked per mixed dispatch's weight stream",
+    buckets=(0, 8, 16, 32, 64, 128, 256, 512),
+)
+MIXED_BUDGET_UTILIZATION = _reg.histogram(
+    "opsagent_mixed_step_budget_utilization",
+    "Fraction of max_step_tokens used per mixed dispatch (0..1)",
+    buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
 )
 KV_PAGE_UTILIZATION = _reg.gauge(
     "opsagent_kv_page_utilization",

@@ -427,22 +427,29 @@ def _gather_kv(
     return k_seq, v_seq
 
 
-def paged_prefix_attention(
-    q: jax.Array,           # [B, S, H, D] tail queries (right-padded)
+def paged_ragged_attention(
+    q: jax.Array,           # [B, S, H, D] queries (right-padded per row)
     k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
     v_pages: jax.Array,     # like k_pages
     page_table: jax.Array,  # [B, MaxP]
-    start: jax.Array,       # [B] cached-prefix lengths (tail begins here)
-    lengths: jax.Array,     # [B] valid TAIL lengths
+    start: jax.Array,       # [B] tokens already in cache (queries begin here)
+    q_lens: jax.Array,      # [B] valid query rows per sequence (0 = inactive)
     layer: jax.Array | None = None,  # [] int32 with the layer-axis form
 ) -> jax.Array:
-    """Tail-prefill attention over paged KV holding [prefix + tail].
+    """Ragged-query paged attention: every batch row carries its own query
+    length, so q_len=1 decode rows and q_len=chunk prefill rows run in ONE
+    program (PAPERS.md: Ragged Paged Attention, arxiv 2604.15464) — the op
+    under the engine's mixed prefill+decode step, where chunked prefill
+    rides the decode dispatch's weight stream instead of buying its own.
 
-    The prefix-cache admission path: the tail's fresh K/V has already been
-    written into pages at offset ``start``; tail query s attends causally to
-    every cached position t <= start + s. Gather-based XLA reference (the
-    Pallas flash variant can come later — admission is not the steady-state
-    hot loop the way decode is)."""
+    Row b's fresh K/V has already been written into pages at offset
+    ``start[b]``; query s attends causally to every cached position
+    t <= start[b] + s (causal masking INSIDE the chunk) and nothing past
+    ``start[b] + q_lens[b]``. Rows with q_lens == 0 produce garbage output
+    (finite — all-masked softmax degrades to uniform) that callers
+    discard. Gather-based XLA reference; the Pallas page-streaming variant
+    is ``paged_ragged_attention_pallas`` behind
+    ``paged_ragged_attention_auto``."""
     k_seq, v_seq = _gather_kv(k_pages, v_pages, page_table, layer, q.dtype)
     B, S, H, _ = q.shape
     K, D = k_seq.shape[-2:]
@@ -455,7 +462,7 @@ def paged_prefix_attention(
     ) * scale
     pos_t = jnp.arange(L)[None, None, :]                   # [1, 1, L]
     pos_q = (start[:, None] + jnp.arange(S)[None, :])[:, :, None]  # [B, S, 1]
-    mask = (pos_t <= pos_q) & (pos_t < (start + lengths)[:, None, None])
+    mask = (pos_t <= pos_q) & (pos_t < (start + q_lens)[:, None, None])
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
@@ -465,6 +472,101 @@ def paged_prefix_attention(
         preferred_element_type=jnp.float32,
     )
     return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def paged_prefix_attention(
+    q: jax.Array,           # [B, S, H, D] tail queries (right-padded)
+    k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
+    v_pages: jax.Array,     # like k_pages
+    page_table: jax.Array,  # [B, MaxP]
+    start: jax.Array,       # [B] cached-prefix lengths (tail begins here)
+    lengths: jax.Array,     # [B] valid TAIL lengths
+    layer: jax.Array | None = None,  # [] int32 with the layer-axis form
+) -> jax.Array:
+    """Tail-prefill attention over paged KV holding [prefix + tail] — the
+    prefix-cache admission path. Prefix attention IS ragged paged
+    attention (per-row write offset + per-row valid tail length), so this
+    is the same op under its admission-era name."""
+    return paged_ragged_attention(
+        q, k_pages, v_pages, page_table, start, lengths, layer=layer
+    )
+
+
+def paged_ragged_attention_pallas_tp(
+    q: jax.Array,           # [B, S, H, D] — H sharded over tp
+    k_pages: jax.Array,     # [N, P, K, D] or [L, N, P, K, D] — K over tp
+    v_pages: jax.Array,     # like k_pages
+    page_table: jax.Array,  # [B, MaxP] replicated
+    start: jax.Array,       # [B] replicated
+    q_lens: jax.Array,      # [B] replicated
+    mesh: Mesh,
+    layer: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """The ragged Pallas kernel under tensor parallelism: shard_mapped over
+    ``tp`` exactly like ``paged_decode_attention_pallas_tp`` — query heads
+    and kv heads are both tp-sharded, the GQA group structure is preserved
+    per shard, and no collective is needed (the all-reduce happens later
+    at the wo row-parallel matmul)."""
+    from .paged_attention_pallas import paged_ragged_attention_pallas
+
+    spec_q = P(None, None, "tp", None)
+    five_d = k_pages.ndim == 5
+    spec_kv = (
+        P(None, None, None, "tp", None) if five_d
+        else P(None, None, "tp", None)
+    )
+    if layer is None:
+        layer = jnp.int32(0)
+
+    def local(q, kp, vp, table, st, ql, ly):
+        return paged_ragged_attention_pallas(
+            q, kp, vp, table, st, ql, interpret=interpret, layer=ly
+        )
+
+    mapped = _shard_map(
+        local, mesh,
+        in_specs=(
+            spec_q, spec_kv, spec_kv, P(None, None), P(None), P(None), P()
+        ),
+        out_specs=spec_q,
+    )
+    return mapped(q, k_pages, v_pages, page_table, start, q_lens, layer)
+
+
+def paged_ragged_attention_auto(
+    q: jax.Array,           # [B, S, H, D]
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, MaxP]
+    start: jax.Array,       # [B]
+    q_lens: jax.Array,      # [B]
+    impl: str = "xla",
+    layer: jax.Array | None = None,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Impl-dispatched ragged paged attention (the mixed-step analogue of
+    ``paged_decode_attention_auto``). int8 KV pages and the manual-DMA
+    backend fall back to the XLA gather: the quantized-scale score trick
+    and the double-buffered page streamer are decode-only so far — a
+    ragged DMA variant is a follow-up once the on-chip sweep justifies
+    it."""
+    if isinstance(k_pages, QuantizedPages) or impl == "pallas-dma":
+        impl = "xla"
+    if impl == "pallas":
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            return paged_ragged_attention_pallas_tp(
+                q, k_pages, v_pages, page_table, start, q_lens, mesh,
+                layer=layer,
+            )
+        from .paged_attention_pallas import paged_ragged_attention_pallas
+
+        return paged_ragged_attention_pallas(
+            q, k_pages, v_pages, page_table, start, q_lens, layer=layer
+        )
+    return paged_ragged_attention(
+        q, k_pages, v_pages, page_table, start, q_lens, layer=layer
+    )
 
 
 def paged_decode_attention(

@@ -13,6 +13,10 @@ actually resident. TWO kernels stream only the owned pages instead:
   through two VMEM slots with manually double-buffered ``make_async_copy``
   DMAs. One grid step per sequence; unowned page slots cost nothing.
 
+A third kernel, ``paged_ragged_attention_pallas``, generalizes the grid
+kernel to RAGGED queries (per-row q_len, causal inside the chunk) for the
+engine's mixed prefill+decode step — see its docstring.
+
 Both use a flash-attention-style online softmax so nothing is
 materialized.
 
@@ -391,6 +395,187 @@ def paged_decode_attention_pallas_dma(
     )(
         page_table.astype(jnp.int32), lengths.astype(jnp.int32), base_arr,
         *operands,
+    )
+    return out
+
+
+def _kernel_ragged(
+    # scalar prefetch
+    table_ref,     # [B, MaxP] int32 page indices (-1 = unassigned)
+    start_ref,     # [B] int32 tokens already in cache (queries begin here)
+    qlens_ref,     # [B] int32 valid query rows (0 = inactive row)
+    base_ref,      # [1] int32 flat-page offset (layer * N; 0 without layers)
+    # blocks
+    q_ref,         # [1, S, H, D]
+    k_ref,         # [1, P, K, D]   (one page, all kv heads)
+    v_ref,         # [1, P, K, D]
+    o_ref,         # [1, S, H, D]
+    # scratch
+    acc_ref,       # [S*H, D]  f32
+    m_ref,         # [S*H, 128] f32 (running max, lane-broadcast)
+    l_ref,         # [S*H, 128] f32 (running denominator)
+    *,
+    page_size: int,
+    num_kv_heads: int,
+):
+    """Ragged-query sibling of ``_kernel``: S query rows per sequence with
+    a per-row valid count, so q_len=1 decode rows and q_len=chunk prefill
+    rows stream pages through ONE program (the mixed-step op). Queries
+    flatten to [S*H, D] — row r is (position r // H, head r % H) — and the
+    causal-inside-the-chunk mask composes with the GQA group select in the
+    same [S*H, P*K] score domain the decode kernel uses. Fully-masked rows
+    (s >= q_len, or a q_len=0 row) keep finite accumulators (exp(0)
+    columns) and emit garbage the host discards."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    P = page_size
+    K = num_kv_heads
+    S = q_ref.shape[1]
+    H = q_ref.shape[2]
+    G = H // K
+    start = start_ref[b]
+    qlen = qlens_ref[b]
+    total = start + qlen           # cache tokens incl. this chunk's writes
+    num_pages = pl.cdiv(total, P)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(p < num_pages)
+    def _accumulate():
+        D = q_ref.shape[-1]
+        scale = D ** -0.5
+        q = q_ref[0].reshape(S * H, D).astype(jnp.float32) * scale
+        kf = k_ref[0].reshape(P * K, D)
+        vf = v_ref[0].reshape(P * K, D)
+        s_full = jax.lax.dot_general(
+            q, kf,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # [S*H, P*K]
+        # Column c holds (token p*P + c//K, kv head c%K); row r holds
+        # (query position start + r//H, query head r%H). Select the GQA
+        # group AND the ragged causal window in one mask.
+        col = jax.lax.broadcasted_iota(jnp.int32, (S * H, P * K), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (S * H, P * K), 0)
+        t = p * P + col // K
+        qpos = start + row // H
+        sel = (
+            (col % K == (row % H) // G)
+            & (t <= qpos)
+            & (t < total)
+            & (row // H < qlen)
+        )
+        s = jnp.where(sel, s_full, NEG_INF)                # [S*H, P*K]
+
+        m_prev = m_ref[:, :1]                              # [S*H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                    # [S*H, 1]
+        probs = jnp.exp(s - m_new)                         # [S*H, P*K]
+        l_new = alpha[:, 0] * l_ref[:, 0] + jnp.sum(probs, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            probs, vf.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_ref[:, :1]                                   # [S*H, 1]
+        safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe).reshape(
+            S, H, q_ref.shape[-1]
+        ).astype(o_ref.dtype)
+
+
+def _page_index_ragged(
+    b, p, table_ref, start_ref, qlens_ref, base_ref, *, page_size
+):
+    """``_page_index`` for the ragged kernel: the valid page count is
+    derived from start + q_len rather than a single lengths vector;
+    past-the-end steps clamp to the last valid page so the pipeline skips
+    the refetch."""
+    num_pages = pl.cdiv(start_ref[b] + qlens_ref[b], page_size)
+    last = jnp.maximum(num_pages - 1, 0)
+    page = table_ref[b, jnp.minimum(p, last)]
+    return (jnp.maximum(page, 0) + base_ref[0], 0, 0, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_ragged_attention_pallas(
+    q: jax.Array,           # [B, S, H, D] right-padded ragged queries
+    k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
+    v_pages: jax.Array,     # like k_pages
+    page_table: jax.Array,  # [B, MaxP] int32
+    start: jax.Array,       # [B] int32 tokens already in cache per row
+    q_lens: jax.Array,      # [B] int32 valid query rows (0 = inactive)
+    interpret: bool = False,
+    layer: jax.Array | None = None,  # [] int32 with the layer-axis form
+) -> jax.Array:
+    """Ragged paged attention, Pallas TPU: grid ``(B, MaxP)`` streaming
+    one page per pipeline step like ``paged_decode_attention_pallas``,
+    but with S query rows per sequence and a per-row valid count — the
+    kernel form of the mixed prefill+decode step (PAPERS.md: Ragged Paged
+    Attention). VMEM cost scales with S (q block + [S*H, D] f32
+    accumulator), so S should stay a modest mixed-chunk bucket, not a
+    full prefill bucket. Correctness oracle:
+    ``ops.attention.paged_ragged_attention``."""
+    if k_pages.ndim == 5:
+        Lr, N, P, K, D = k_pages.shape
+        k_pages = k_pages.reshape(Lr * N, P, K, D)
+        v_pages = v_pages.reshape(Lr * N, P, K, D)
+        base = (layer if layer is not None else 0) * N
+    else:
+        N, P, K, D = k_pages.shape
+        base = 0
+    B, S, H, _ = q.shape
+    MaxP = page_table.shape[1]
+    base_arr = jnp.full((1,), base, jnp.int32)
+
+    page_map = functools.partial(_page_index_ragged, page_size=P)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, MaxP),
+        in_specs=[
+            pl.BlockSpec(
+                (1, S, H, D), lambda b, p, t, st, ql, ba: (b, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, S, H, D), lambda b, p, t, st, ql, ba: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((S * H, D), jnp.float32),
+            pltpu.VMEM((S * H, 128), jnp.float32),
+            pltpu.VMEM((S * H, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel_ragged, page_size=P, num_kv_heads=K),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * B * S * H * D * MaxP * P,
+            bytes_accessed=(
+                B * MaxP * P * K * D * 2 * k_pages.dtype.itemsize
+                + B * S * H * D * 2 * q.dtype.itemsize
+            ),
+            transcendentals=B * S * H * MaxP * P,
+        ),
+    )(
+        page_table.astype(jnp.int32), start.astype(jnp.int32),
+        q_lens.astype(jnp.int32), base_arr,
+        q, k_pages, v_pages,
     )
     return out
 

@@ -53,6 +53,26 @@ def record_dispatch(kind: str, rows: int, steps: int) -> None:
         ).observe(rows)
 
 
+def record_mixed_dispatch(
+    decode_rows: int, prefill_tokens: int, budget: int
+) -> None:
+    """Composition telemetry for one MIXED prefill+decode dispatch
+    (engine.step_mixed): how many decode lanes rode the dispatch, how many
+    prefill chunk tokens piggybacked on its weight stream, and what
+    fraction of the per-dispatch token budget (max_step_tokens) the two
+    together used. These are the series the sessions-mixed bench stage
+    uses to attribute the one-weight-stream-per-tick win."""
+    from .. import obs
+
+    obs.DECODE_DISPATCHES.inc(kind="mixed")
+    obs.MIXED_DECODE_LANES.observe(max(0, decode_rows))
+    obs.MIXED_PREFILL_TOKENS.observe(max(0, prefill_tokens))
+    if budget > 0:
+        obs.MIXED_BUDGET_UTILIZATION.observe(
+            min(1.0, (decode_rows + prefill_tokens) / budget)
+        )
+
+
 def decode_block(
     params: Any,
     cfg: ModelConfig,

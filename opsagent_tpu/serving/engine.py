@@ -152,6 +152,26 @@ class EngineConfig:
     # synchronous (pull immediately after each dispatch).
     pipeline_depth: int = 2
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024, 2048, 4096)
+    # Mixed prefill+decode batching (Sarathi-style piggybacking over the
+    # ragged paged-attention op): the scheduler tick packs every decode
+    # lane (one token each) PLUS up to max_step_tokens of chunked-prefill
+    # tokens into ONE device dispatch (step_mixed), so prefill rides the
+    # decode dispatch's weight stream instead of buying its own — on a
+    # weight-streaming-bound model the split tick streams ~all weights
+    # TWICE per tick (one prefill program + one decode program). Decode
+    # lanes get budget first; the remainder goes to the oldest admitting
+    # prompts. The split path remains the fallback (flag off, no admitting
+    # prompts, or rows needing host-side per-token work).
+    mixed_batching: bool = True
+    # Per-mixed-dispatch token budget: decode lanes (1 token each) are
+    # funded first, remaining budget seats prefill chunk tokens.
+    max_step_tokens: int = 256
+    # Chunk-size buckets for the mixed program's query axis: the chunk
+    # pads to the smallest bucket holding it, so XLA compiles one program
+    # per bucket (warmed by warmup()) and the post-warmup-zero-compiles
+    # invariant survives arbitrary batch compositions. Kept modest — the
+    # ragged Pallas kernel's VMEM accumulator scales with the bucket.
+    mixed_buckets: tuple[int, ...] = (16, 32, 64, 128)
     max_new_tokens_default: int = 1024
     seed: int = 0
     prefix_cache: bool = True
@@ -479,6 +499,22 @@ class Engine:
             tl, ti = jax.lax.top_k(lp, 20)
             return tok.astype(jnp.int32), chosen, ti.astype(jnp.int32), tl, cache
 
+        def _mixed_sample(
+            params, tokens, starts, qlens, cache, table,
+            key, temps, top_k, top_p,
+        ):
+            """One fused mixed prefill+decode dispatch: ragged forward over
+            decode rows (q_len=1) and prefill chunk rows (q_len=chunk) in
+            the same batch, then one sample over every row's last-valid
+            logits. Rows whose chunk does not finish its prompt get their
+            sampled token discarded on host; q_len=0 rows are inert."""
+            logits, cache = llama.mixed_step(
+                params, mc, tokens, starts, qlens, cache, table, dtype=dt,
+                attn_impl=self.attn_impl, mesh=self.mesh,
+            )
+            tok = sample(logits, key, temps, top_k, top_p, None)
+            return tok.astype(jnp.int32), cache
+
         def _decode_pipeline(
             params, carry_tok, carry_at, carry_eos, key,
             override, ov_tok, ov_at, alive, budgets, cache, table,
@@ -516,6 +552,9 @@ class Engine:
             _decode_pipeline,
             donate_argnames=("cache", "carry_tok", "carry_at", "carry_eos", "key"),
             static_argnames=("greedy",),
+        )
+        self._mixed_sample_jit = jax.jit(
+            _mixed_sample, donate_argnames=("cache",)
         )
         self._sample_jit = jax.jit(sample)
 
@@ -582,12 +621,12 @@ class Engine:
         ),
         "sessions": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
-            "decode_greedy",
+            "decode_greedy", "mixed",
         }),
         "full": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
             "decode_single", "logprobs", "decode_greedy", "decode_sampled",
-            "fsm", "spec",
+            "fsm", "spec", "mixed",
         }),
     }
 
@@ -674,6 +713,24 @@ class Engine:
             zf = jnp.zeros((B,), jnp.float32)
             of = jnp.ones((B,), jnp.float32)
             inactive = jnp.zeros((B,), bool)
+            toks = None
+            # Mixed prefill+decode programs: one per chunk bucket (the
+            # query axis is the only shape that varies — decode-lane count
+            # and chunk sizes within a bucket are DATA, not shape — so
+            # this cross-product keeps mixed dispatches compile-free no
+            # matter the batch composition). q_lens all zero: inert rows,
+            # no KV writes, all-dropped tables.
+            if "mixed" in progs and self.cfg.mixed_batching:
+                for sb in self.cfg.mixed_buckets:
+                    self._sample_key, sub = jax.random.split(self._sample_key)
+                    toks, self.cache = self._mixed_sample_jit(
+                        self.params,
+                        jnp.zeros((B, sb), jnp.int32),
+                        zi, zi,
+                        self.cache,
+                        dropB,
+                        sub, zf, zi, of,
+                    )
             if "decode_single" in progs:
                 self._sample_key, sub = jax.random.split(self._sample_key)
                 _, self.cache = self._decode_sample_jit(
@@ -701,7 +758,6 @@ class Engine:
                         self.params, zi, zi, self.cache, dropB, inactive,
                         sub, zf, zi, of, None, b,
                     )
-            toks = None
             greedy_variants = [
                 g for g in (True, False)
                 if ("decode_greedy" if g else "decode_sampled") in progs
@@ -1084,6 +1140,227 @@ class Engine:
                 self._prefilling.pop(seq_id, None)
                 self.alloc.free(seq_id)
                 raise
+
+    # -- mixed prefill+decode step -------------------------------------------
+    def _mixed_bucket(self, n: int) -> int:
+        """Smallest mixed-chunk bucket holding n query rows."""
+        for b in self.cfg.mixed_buckets:
+            if n <= b:
+                return b
+        return self.cfg.mixed_buckets[-1]
+
+    def mixed_hosted(self, seq_id: int) -> bool:
+        """True when this sequence needs host-side per-token work — a
+        constrained-decoding mask, logprobs, or a logit bias/penalty —
+        that the fused mixed program does not serve. The scheduler routes
+        ticks involving such rows to the split prefill/decode path."""
+        with self.lock:
+            s = self.sequences.get(seq_id)
+            if s is None:
+                return False
+            return bool(
+                s.mask_fn is not None
+                or s.params.logprobs
+                or self._needs_bias(s)
+            )
+
+    def prefill_progress(self, seq_id: int) -> tuple[int, int]:
+        """(tokens already prefilled, prompt length) for an admitting
+        sequence — the scheduler's input for sizing mixed-step chunks."""
+        with self.lock:
+            return self._prefilling[seq_id], self.sequences[seq_id].prompt_len
+
+    def step_mixed(
+        self, decode_ids: list[int], prefill_chunks: dict[int, int]
+    ) -> tuple[dict[int, list[int]], dict[int, Any]]:
+        """ONE device dispatch that advances every given decode lane by
+        one token AND runs one prefill chunk for each admitting sequence
+        in ``prefill_chunks`` ({seq_id: chunk tokens}) — the unified mixed
+        step. The chunk rows pad into the smallest ``mixed_buckets`` entry
+        holding the largest chunk, decode rows ride at q_len=1, and the
+        whole batch shares one weight stream (the split tick streams
+        weights once for the prefill program and again for the decode
+        program; on a weight-streaming-bound model that is the dominant
+        per-tick cost). TTFT stops being quantized to decode-block
+        boundaries because an admitting prompt advances every tick.
+
+        The mixed path bypasses the device-resident block-decode carry, so
+        any in-flight pipelined state is flushed first (same contract as
+        ``step``); rows needing host-side per-token work are the caller's
+        job to exclude (see ``mixed_hosted``).
+
+        Returns ``(decode_out, prefill_out)``: decode_out maps each
+        advanced decode sequence to its new token; prefill_out follows the
+        ``prefill_batch`` contract ({seq_id: fully_prefilled | Exception},
+        row-local failures isolated). Unlike ``step``, a raising stream
+        callback on a decode row does NOT propagate — the row finishes
+        with reason "error" (the scheduler's reap path surfaces it) so the
+        prefill results of the same dispatch are never lost. A failed
+        DISPATCH cleans up every chunk admission, rolls back the decode
+        rows' one-token page bookings, and re-raises."""
+        with self.lock:
+            if self._inflight or self._lane_of:
+                # Settle the pipelined block-decode state: its device
+                # carry tracks lane write offsets that a mixed dispatch
+                # would silently desync.
+                self._flush_and_invalidate()
+            decode = [
+                self.sequences[s] for s in decode_ids
+                if s in self.sequences and not self.sequences[s].done
+            ]
+            B = self.cfg.max_batch_size
+            if len(decode) + len(prefill_chunks) > B:
+                raise ValueError(
+                    f"mixed batch of {len(decode)} decode + "
+                    f"{len(prefill_chunks)} prefill rows exceeds "
+                    f"max_batch_size={B}"
+                )
+            # Book the token each decode row is about to write (the
+            # step() contract: a row that cannot grow finishes as
+            # truncated instead of killing the dispatch).
+            grown: list[Sequence] = []
+            for s in decode:
+                try:
+                    self.alloc.extend(s.seq_id, 1)
+                    grown.append(s)
+                except OutOfPages:
+                    s.done = True
+                    s.finish_reason = "length"
+                    obs.PREEMPTIONS.inc()
+                    log.warning(
+                        "seq %d truncated: KV page budget exhausted",
+                        s.seq_id,
+                    )
+            decode = grown
+            decode_out: dict[int, list[int]] = {}
+            prefill_out: dict[int, Any] = {}
+            if not decode and not prefill_chunks:
+                return decode_out, prefill_out
+            chunk_info: list[tuple[int, Sequence, int, int]] = []
+            smax = 1
+            for sid, want in prefill_chunks.items():
+                seq = self.sequences[sid]
+                done = self._prefilling[sid]
+                c = min(
+                    want, self.cfg.mixed_buckets[-1], seq.prompt_len - done
+                )
+                chunk_info.append((sid, seq, done, c))
+                smax = max(smax, c)
+            S = self._mixed_bucket(smax)
+            tokens = np.full((B, S), self.tokenizer.pad_id, np.int32)
+            starts = np.zeros((B,), np.int32)
+            qlens = np.zeros((B,), np.int32)
+            tables = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
+            for i, s in enumerate(decode):
+                tokens[i, 0] = (
+                    s.tokens[-1] if s.tokens else self.tokenizer.bos_id
+                )
+                # extend(1) above made alloc.length = written + 1; the row
+                # writes (and attends from) the written offset.
+                starts[i] = self.alloc.length(s.seq_id) - 1
+                qlens[i] = 1
+                tables[i] = self.alloc.page_table_row(s.seq_id)
+            base = len(decode)
+            for j, (sid, seq, done, c) in enumerate(chunk_info):
+                tokens[base + j, :c] = seq.prompt_ids[done:done + c]
+                starts[base + j] = done
+                qlens[base + j] = c
+                tables[base + j] = self.alloc.page_table_row(sid)
+            slots: list[Sequence | None] = (
+                decode + [seq for _, seq, _, _ in chunk_info]
+            )
+            slots += [None] * (B - len(slots))
+            temps, top_k, top_p, _ = self._sampling_arrays(slots, B)
+            perf = get_perf_stats()
+            t_disp = time.perf_counter()
+            try:
+                dev_out: list = []
+                with annotate("engine.mixed_step"), \
+                        device_timer("mixed_step", dev_out), self.mesh_ctx():
+                    self._sample_key, sub = jax.random.split(self._sample_key)
+                    toks_d, self.cache = self._mixed_sample_jit(
+                        self.params,
+                        jnp.asarray(tokens),
+                        jnp.asarray(starts),
+                        jnp.asarray(qlens),
+                        self.cache,
+                        jnp.asarray(tables),
+                        sub,
+                        jnp.asarray(temps),
+                        jnp.asarray(top_k),
+                        jnp.asarray(top_p),
+                    )
+                    dev_out.append(toks_d)
+                sampled = np.asarray(toks_d)
+            except Exception:
+                # The decode rows' +1 bookings are for tokens this failed
+                # dispatch never wrote; leaving them would put an
+                # unwritten hole inside the attended window next step.
+                for s in decode:
+                    if not s.done:
+                        self.alloc.truncate(
+                            s.seq_id, self.alloc.length(s.seq_id) - 1
+                        )
+                for sid, *_ in chunk_info:
+                    self._drop_admission(sid)
+                raise
+            perf.record_metric(
+                "engine.mixed_dispatch",
+                (time.perf_counter() - t_disp) * 1e3, "ms",
+            )
+            n_prefill = int(sum(c for *_, c in chunk_info))
+            if n_prefill:
+                perf.record_metric(
+                    "engine.prefill_tokens", n_prefill, "tok"
+                )
+                obs.PREFILL_TOKENS.inc(n_prefill)
+            from .decode_loop import record_mixed_dispatch
+
+            record_mixed_dispatch(
+                decode_rows=len(decode),
+                prefill_tokens=n_prefill,
+                budget=self.cfg.max_step_tokens,
+            )
+            for i, s in enumerate(decode):
+                tok = int(sampled[i])
+                dspan = s.decode_span
+                try:
+                    self._accept_token(s, tok)
+                except Exception:  # noqa: BLE001 - raising stream callback
+                    # Row-local isolation WITHOUT propagation: the reap
+                    # path surfaces finish_reason "error"; raising here
+                    # would lose the same dispatch's prefill results.
+                    s.done = True
+                    s.finish_reason = s.finish_reason or "error"
+                    self.alloc.truncate(s.seq_id, self._host_written(s))
+                decode_out[s.seq_id] = [tok]
+                if dspan is not None:
+                    dspan.child(
+                        "mixed_step", t_disp, time.perf_counter(), tokens=1
+                    )
+            for j, (sid, seq, done, c) in enumerate(chunk_info):
+                if done + c < seq.prompt_len:
+                    self._prefilling[sid] = done + c
+                    prefill_out[sid] = False
+                    continue
+                del self._prefilling[sid]
+                token = int(sampled[base + j])
+                seq.ttft_s = time.perf_counter() - seq.started_s
+                perf.record_metric("engine.ttft", seq.ttft_s * 1e3, "ms")
+                self._first_token_obs(seq)
+                try:
+                    self._accept_token(seq, token)
+                except Exception as e:  # noqa: BLE001 - stream callback
+                    self._drop_admission(sid)
+                    prefill_out[sid] = e
+                    continue
+                prefill_out[sid] = True
+            if decode:
+                perf.record_metric(
+                    "engine.decode_tokens", len(decode), "tok"
+                )
+            self._observe_occupancy()
+            return decode_out, prefill_out
 
     def _sampling_arrays(
         self, seqs: list[Sequence | None], B: int

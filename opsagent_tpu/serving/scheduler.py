@@ -6,6 +6,14 @@ feeds prefill as pages free up; all running sequences advance together in
 decode steps; finished sequences release pages immediately, letting queued
 requests enter mid-flight. Runs in a dedicated thread — JAX dispatch is
 blocking — with asyncio-friendly completion events.
+
+With ``EngineConfig.mixed_batching`` (default on) a tick with admitting
+prompts runs ONE mixed dispatch — every decode lane plus up to
+``max_step_tokens`` of chunked-prefill tokens in the same batch
+(``Scheduler._mixed_tick`` -> ``Engine.step_mixed``) — instead of the
+serialized prefill-chunk dispatch followed by a decode-block dispatch,
+which streams the model weights twice per tick. The split path remains
+the fallback for host-stepped rows and the flag-off configuration.
 """
 
 from __future__ import annotations
@@ -237,6 +245,72 @@ class Scheduler:
             elif res:
                 self._running[sid] = self._prefilling.pop(sid)
 
+    def _mixed_tick(self) -> bool:
+        """The unified mixed prefill+decode tick (EngineConfig
+        .mixed_batching): ONE engine dispatch advances every running
+        decode lane by a token AND seats prefill chunks for the oldest
+        admitting prompts, under a token budget — decode lanes are funded
+        first (1 token each), the remaining ``max_step_tokens`` budget
+        goes to admitting prompts in arrival order. Versus the split
+        ``_advance_prefill(); step_block()`` tick this streams the model
+        weights ONCE per tick instead of twice, and an admitting prompt
+        advances every tick instead of waiting out a full decode block —
+        TTFT is no longer quantized to decode-block boundaries.
+
+        Returns True when a mixed dispatch ran (the caller skips the split
+        tick); False routes the tick to the split path — no admitting
+        prompts, some involved row needs host-side per-token work
+        (constrained mask / logprobs / logit bias), or the budget left no
+        room for a chunk."""
+        eng = self.engine
+        if not getattr(eng.cfg, "mixed_batching", False):
+            return False
+        if not self._prefilling:
+            return False
+        for sid in list(self._running) + list(self._prefilling):
+            if eng.mixed_hosted(sid):
+                return False
+        decode_ids = sorted(
+            sid for sid in self._running
+            if sid in eng.sequences and not eng.sequences[sid].done
+        )
+        budget = eng.cfg.max_step_tokens - len(decode_ids)
+        rows_left = eng.cfg.max_batch_size - len(decode_ids)
+        cap = eng.cfg.mixed_buckets[-1]
+        chunks: dict[int, int] = {}
+        # dict order is admission order: oldest admitting prompts first.
+        for sid in self._prefilling:
+            if budget <= 0 or rows_left <= 0:
+                break
+            try:
+                done, total = eng.prefill_progress(sid)
+            except KeyError:
+                continue  # raced with a failure path; reaped elsewhere
+            c = min(total - done, budget, cap)
+            if c <= 0:
+                continue
+            chunks[sid] = c
+            budget -= c
+            rows_left -= 1
+        if not chunks:
+            return False
+        try:
+            _, prefill_out = eng.step_mixed(decode_ids, chunks)
+        except Exception as e:  # noqa: BLE001 - engine cleaned up already
+            # The engine dropped every chunk admission before re-raising;
+            # fail those requests, then let the loop's failure accounting
+            # see the dispatch error (persistent engine failures must
+            # still trigger recovery).
+            for sid in chunks:
+                self._fail_admission(sid, e)
+            raise
+        for sid, res in prefill_out.items():
+            if isinstance(res, Exception):
+                self._fail_admission(sid, res)
+            elif res:
+                self._running[sid] = self._prefilling.pop(sid)
+        return True
+
     def _fail_admission(self, sid: int, e: Exception) -> None:
         req = self._prefilling.pop(sid, None)
         if req is None:
@@ -376,7 +450,12 @@ class Scheduler:
             try:
                 self._drain_queue()
                 self._try_admit()
-                self._advance_prefill()
+                # Mixed tick first: one dispatch covers decode AND a
+                # prefill chunk (one weight stream). Falls back to the
+                # split prefill-then-decode tick when it cannot run.
+                mixed = self._mixed_tick()
+                if not mixed:
+                    self._advance_prefill()
                 self._reap()
                 if not self._running:
                     if self._prefilling:
@@ -385,8 +464,9 @@ class Scheduler:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
-                self.engine.step_block(sorted(self._running))
-                self._reap()
+                if not mixed:
+                    self.engine.step_block(sorted(self._running))
+                    self._reap()
                 consecutive_failures = 0
             except Exception as e:  # noqa: BLE001 - the loop must survive
                 # A raising stream callback surfaces here after the engine

@@ -159,3 +159,30 @@ def test_agent_mode_reports_per_turn_ttft_and_hit_rate():
     assert e["turns_completed"] == 3 * 3
     assert e["prefix_hit_rate"] > 0  # turn >= 2 prompts must hit the trie
     assert e["turn1_p50_ttft_ms"] > 0
+
+
+def test_sessions_mixed_mode_reports_both_variants():
+    """OPSAGENT_BENCH_MODE=sessions-mixed (the tier-1-safe fast-lane form
+    of the on-chip N=32 stage: CPU, tiny model, small N) must run the
+    sessions workload with mixed batching ON and OFF against one engine
+    and emit BOTH variants in the JSON line, so the
+    one-weight-stream-per-tick delta is a first-class artifact."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "sessions-mixed",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("sessions_mixed[")
+    assert parsed["unit"] == "tok/s/chip"
+    e = parsed["extra"]
+    assert e["errors"] == 0
+    # Both phases measured and distinguishable.
+    assert e["p50_ttft_ms"] > 0 and e["split_p50_ttft_ms"] > 0
+    assert "ttft_delta_ms" in e and "tok_s_chip_delta" in e
+    # The mixed phase actually dispatched mixed programs.
+    assert e["metrics"]['opsagent_decode_dispatches_total{kind="mixed"}'] > 0

@@ -1,0 +1,276 @@
+"""Mixed prefill+decode batching (one weight stream per step).
+
+Covers the ISSUE-2 acceptance gates on the tiny CPU engine: (a) greedy
+token equivalence of the mixed path vs. the split prefill/decode path,
+(b) the scheduler's token-budget policy (decode lanes funded first,
+remainder to the oldest admitting prompts, honoring max_step_tokens),
+(c) ZERO post-warmup XLA compiles across varied mixed-batch compositions
+(the r04 sessions invariant, extended to the mixed programs), and
+(d) prefix-cache hits still applying to chunks seated in mixed
+dispatches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.sampler import SamplingParams
+from opsagent_tpu.serving.scheduler import Request, Scheduler
+
+BASE = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+    num_pages=128, max_pages_per_seq=24, max_batch_size=4,
+    prefill_buckets=(8, 16), decode_block=4,
+    mixed_buckets=(4, 8, 16), max_step_tokens=32,
+)
+
+# Count real XLA compiles process-wide: the monitoring event fires once
+# per backend compile and never on jit-cache hits. Registered once at
+# import (jax.monitoring has no public deregistration); tests diff the
+# counter around the window they care about.
+_COMPILES: list[str] = []
+
+
+def _on_event(name: str, *a, **kw) -> None:
+    if name == "/jax/core/compile/backend_compile_duration":
+        _COMPILES.append(name)
+
+
+jax.monitoring.register_event_duration_secs_listener(_on_event)
+
+
+def _drain_all(eng, sids):
+    live = [s for s in sids if not eng.sequences[s].done]
+    while live:
+        eng.step_block(sorted(live))
+        live = [s for s in live if not eng.sequences[s].done]
+    eng.drain()
+
+
+def test_mixed_scheduler_matches_split_greedy():
+    """(a) End-to-end through the scheduler: concurrent short + long
+    prompts decoded under the mixed tick must be token-identical to the
+    split-path oracle."""
+    prompts = [
+        [257, 9, 8, 7],
+        [257] + list(range(1, 40)),     # multiple chunks
+        [257, 5, 5, 5, 5, 5],
+    ]
+    budgets = [12, 6, 9]
+    split = Engine(EngineConfig(mixed_batching=False, **BASE))
+    want = [
+        split.generate([p], SamplingParams(max_tokens=n))[0]
+        for p, n in zip(prompts, budgets)
+    ]
+
+    eng = Engine(EngineConfig(mixed_batching=True, **BASE))
+    sched = Scheduler(eng)
+    sched.start()
+    try:
+        reqs = [
+            sched.submit(Request(p, SamplingParams(max_tokens=n)))
+            for p, n in zip(prompts, budgets)
+        ]
+        for r in reqs:
+            assert r.done.wait(180)
+            assert not r.error, r.error
+        assert [r.tokens for r in reqs] == want
+    finally:
+        sched.stop()
+
+
+def test_step_mixed_direct_matches_split_greedy():
+    """(a) Engine-level: driving admission chunk-by-chunk through
+    step_mixed while a decode lane rides along must reproduce both
+    sequences' split-path generations exactly."""
+    short = [257, 9, 8, 7]
+    long_prompt = [257] + list(range(1, 40))
+    split = Engine(EngineConfig(mixed_batching=False, **BASE))
+    want_short = split.generate([short], SamplingParams(max_tokens=12))[0]
+    want_long = split.generate([long_prompt], SamplingParams(max_tokens=6))[0]
+
+    eng = Engine(EngineConfig(mixed_batching=True, **BASE))
+    a = eng.add_request(short, SamplingParams(max_tokens=12))
+    b = eng.begin_request(long_prompt, SamplingParams(max_tokens=6))
+    collected = list(eng.sequences[a].tokens)
+    mixed_dispatches = 0
+    while b in eng._prefilling:
+        done, total = eng.prefill_progress(b)
+        dids = [a] if not eng.sequences[a].done else []
+        d_out, p_out = eng.step_mixed(dids, {b: min(total - done, 16)})
+        mixed_dispatches += 1
+        collected.extend(d_out.get(a, []))
+        assert not isinstance(p_out[b], Exception)
+    assert mixed_dispatches >= 3          # 40 tokens through bucket-16 chunks
+    _drain_all(eng, [a, b])
+    collected.extend([])  # decode lane tokens already folded in
+    while not eng.sequences[a].done:
+        collected.extend(eng.step_block([a]).get(a, []))
+    got_a, got_b = eng.finish(a), eng.finish(b)
+    assert got_a == want_short
+    assert got_b == want_long
+    # The decode lane advanced DURING admission (mixed piggybacking).
+    assert len(collected) > 1
+
+
+def test_budget_policy_honors_max_step_tokens_and_decode_priority():
+    """(b) Decode lanes are funded first; the admitting prompt gets
+    exactly max_step_tokens - lanes (capped by the bucket ceiling), and a
+    budget fully consumed by decode lanes yields no mixed dispatch."""
+    cfg = dict(BASE, max_step_tokens=6, mixed_buckets=(4, 8, 16))
+    eng = Engine(EngineConfig(**cfg))
+    sched = Scheduler(eng)  # never started: ticks driven by hand
+    short = [257, 1, 2, 3]
+    long_prompt = [257] + list(range(1, 30))
+    sched.submit(Request(short, SamplingParams(max_tokens=8)))
+    sched._drain_queue()
+    sched._try_admit()
+    # Finish the short prompt's admission so it becomes a decode lane.
+    while sched._prefilling:
+        sched._advance_prefill()
+    assert len(sched._running) == 1
+    sched.submit(Request(long_prompt, SamplingParams(max_tokens=4)))
+    sched._drain_queue()
+    sched._try_admit()
+    (bid,) = list(sched._prefilling)
+    assert sched._mixed_tick() is True
+    done, total = eng.prefill_progress(bid)
+    # budget 6 - 1 decode lane = 5 chunk tokens, NOT the full bucket.
+    assert done == 5
+    # Starve the prefill budget entirely: lanes >= max_step_tokens.
+    eng.cfg.max_step_tokens = 1
+    assert sched._mixed_tick() is False   # falls back to the split tick
+    eng.cfg.max_step_tokens = 64
+    assert sched._mixed_tick() is True
+    done2, _ = eng.prefill_progress(bid)
+    assert done2 - done == min(16, total - done)  # bucket-capped chunk
+    # Drain cleanly so the engine holds no half-admitted state.
+    while sched._prefilling:
+        if not sched._mixed_tick():
+            sched._advance_prefill()
+        sched._reap()
+    for sid in list(sched._running):
+        while not eng.sequences[sid].done:
+            eng.step_block([sid])
+        eng.drain()
+    sched._reap()
+
+
+def test_zero_compiles_after_warmup_across_mixed_compositions():
+    """(c) The r04 invariant extended to mixed batching: after a
+    sessions-level warmup, NO mixed-batch composition — varying decode
+    lane counts, chunk sizes across every bucket, completing prompts,
+    prefix-cache-backed chunks — may trigger an XLA compile."""
+    cfg = EngineConfig(mixed_batching=True, **BASE)
+    eng = Engine(cfg)
+    eng.warmup("sessions")
+    sampling = SamplingParams(max_tokens=6)
+
+    n0 = len(_COMPILES)
+    rng = np.random.default_rng(3)
+    # Composition sweep: prompts sized to hit chunk buckets 4/8/16 with
+    # 0..2 decode lanes riding along.
+    sids: list[int] = []
+    for plen in (3, 7, 13, 21, 37):
+        prompt = [257] + [int(t) for t in rng.integers(1, 400, plen - 1)]
+        b = eng.begin_request(prompt, sampling)
+        while b in eng._prefilling:
+            done, total = eng.prefill_progress(b)
+            lanes = [s for s in sids if not eng.sequences[s].done][:2]
+            eng.step_mixed(lanes, {b: min(total - done, 16)})
+        sids.append(b)
+    _drain_all(eng, sids)
+    for s in sids:
+        eng.finish(s)
+    assert len(_COMPILES) == n0, (
+        f"{len(_COMPILES) - n0} post-warmup compiles in mixed dispatches"
+    )
+
+
+def test_prefix_cache_hits_apply_to_mixed_chunks():
+    """(d) A prompt sharing a cached prefix must start its mixed-path
+    admission AT the matched offset (skipping the cached pages) and still
+    generate exactly the uncached oracle's tokens."""
+    base = [257] + list(range(1, 25))          # 24 tokens -> 6 full pages
+    extended = base + [300, 301, 302, 303]
+    split = Engine(EngineConfig(mixed_batching=False, **BASE))
+    want = split.generate([extended], SamplingParams(max_tokens=6))[0]
+
+    eng = Engine(EngineConfig(mixed_batching=True, **BASE))
+    # Populate the trie: run the base prompt to completion and free it.
+    a = eng.add_request(base, SamplingParams(max_tokens=4))
+    _drain_all(eng, [a])
+    eng.finish(a)
+
+    hit0 = eng.alloc.hit_tokens
+    b = eng.begin_request(extended, SamplingParams(max_tokens=6))
+    assert eng.alloc.hit_tokens > hit0         # prefix matched at admission
+    matched = eng._prefilling[b]
+    assert matched > 0 and matched % eng.cfg.page_size == 0
+    chunks = 0
+    while b in eng._prefilling:
+        done, total = eng.prefill_progress(b)
+        assert done >= matched                 # never re-prefills the prefix
+        eng.step_mixed([], {b: min(total - done, 16)})
+        chunks += 1
+    # The un-matched tail is < one bucket: exactly one mixed chunk.
+    assert chunks == 1
+    _drain_all(eng, [b])
+    assert eng.finish(b) == want
+
+
+def test_hosted_rows_fall_back_to_split_path():
+    """A request needing host-side per-token work (logprobs) must route
+    the tick to the split path — and still complete correctly alongside
+    an admitting prompt under the mixed scheduler."""
+    eng = Engine(EngineConfig(mixed_batching=True, **BASE))
+    split = Engine(EngineConfig(mixed_batching=False, **BASE))
+    p1 = [257, 3, 1, 4, 1, 5]
+    p2 = [257] + list(range(1, 20))
+    want1 = split.generate([p1], SamplingParams(max_tokens=5))[0]
+    want2 = split.generate([p2], SamplingParams(max_tokens=5))[0]
+
+    sched = Scheduler(eng)
+    sched.start()
+    try:
+        r1 = sched.submit(Request(
+            p1, SamplingParams(max_tokens=5, logprobs=True, top_logprobs=2)
+        ))
+        r2 = sched.submit(Request(p2, SamplingParams(max_tokens=5)))
+        assert r1.done.wait(180) and r2.done.wait(180)
+        assert not r1.error and not r2.error
+        assert r1.tokens == want1
+        assert r2.tokens == want2
+        assert len(r1.logprob_data) == len(r1.tokens)
+    finally:
+        sched.stop()
+
+
+def test_mixed_dispatch_composition_metrics_recorded():
+    """The obs composition series (decode lanes, prefill tokens, budget
+    utilization) must tick once per mixed dispatch."""
+    from opsagent_tpu import obs
+
+    snap0 = obs.metrics_snapshot()
+    c0 = snap0.get("opsagent_mixed_dispatch_decode_lanes_count", 0)
+    eng = Engine(EngineConfig(mixed_batching=True, **BASE))
+    a = eng.add_request([257, 2, 3, 4], SamplingParams(max_tokens=8))
+    b = eng.begin_request(
+        [257] + list(range(1, 20)), SamplingParams(max_tokens=4)
+    )
+    n = 0
+    while b in eng._prefilling:
+        done, total = eng.prefill_progress(b)
+        eng.step_mixed([a], {b: min(total - done, 16)})
+        n += 1
+    snap1 = obs.metrics_snapshot()
+    assert snap1["opsagent_mixed_dispatch_decode_lanes_count"] == c0 + n
+    assert snap1["opsagent_mixed_dispatch_prefill_tokens_sum"] >= 19 - 16
+    assert (
+        snap1['opsagent_decode_dispatches_total{kind="mixed"}']
+        >= snap0.get('opsagent_decode_dispatches_total{kind="mixed"}', 0) + n
+    )
+    _drain_all(eng, [a, b])
+    eng.finish(a), eng.finish(b)

@@ -324,3 +324,91 @@ def test_pallas_dma_length_beyond_table_clamps():
         np.asarray(got)[0], np.asarray(ref)[0], rtol=2e-5, atol=2e-5
     )
     assert not np.isnan(np.asarray(got)).any()
+
+
+# -- ragged-query kernel (mixed prefill+decode step) -------------------------
+def _make_ragged_case(rng, B, S, H, K, D, P, MaxP, num_pages, start, q_lens):
+    """Random paged KV state for the ragged kernel: each row owns enough
+    pages for start + q_len tokens (the chunk's KV is treated as already
+    written, like the engine after write_kv_pages)."""
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.standard_normal((num_pages, P, K, D)), jnp.float32
+    )
+    v_pages = jnp.asarray(
+        rng.standard_normal((num_pages, P, K, D)), jnp.float32
+    )
+    table = np.full((B, MaxP), -1, np.int32)
+    free = list(range(num_pages))
+    rng.shuffle(free)
+    for b in range(B):
+        need = -(-(start[b] + q_lens[b]) // P)
+        for i in range(need):
+            table[b, i] = free.pop()
+    return (
+        q, k_pages, v_pages, jnp.asarray(table),
+        jnp.asarray(start, jnp.int32), jnp.asarray(q_lens, jnp.int32),
+    )
+
+
+@pytest.mark.parametrize(
+    "B,S,H,K,D,P,MaxP,start,q_lens",
+    [
+        # decode row (q_len=1) + prefill chunk + inactive row in one batch
+        (3, 8, 4, 2, 32, 4, 8, [9, 4, 0], [1, 6, 0]),
+        # fresh prompt chunk from position 0, full S
+        (2, 8, 4, 4, 16, 8, 4, [0, 0], [8, 3]),
+        # chunk crossing page boundaries with a long cached prefix
+        (2, 4, 8, 2, 32, 4, 10, [13, 30], [4, 2]),
+    ],
+)
+def test_ragged_pallas_matches_xla_reference(
+    B, S, H, K, D, P, MaxP, start, q_lens
+):
+    from opsagent_tpu.ops.attention import paged_ragged_attention
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas,
+    )
+
+    rng = np.random.default_rng(11)
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B, S, H, K, D, P, MaxP, num_pages=B * MaxP + 2,
+        start=start, q_lens=q_lens,
+    )
+    ref = paged_ragged_attention(q, k_pages, v_pages, table, st, ql)
+    got = paged_ragged_attention_pallas(
+        q, k_pages, v_pages, table, st, ql, interpret=True
+    )
+    # Compare only valid query rows; padded rows (s >= q_len) are garbage
+    # in both but must stay finite.
+    for b in range(B):
+        n = q_lens[b]
+        if n:
+            np.testing.assert_allclose(
+                np.asarray(got)[b, :n], np.asarray(ref)[b, :n],
+                rtol=2e-5, atol=2e-5,
+            )
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_ragged_decode_row_matches_decode_kernel_semantics():
+    """A q_len=1 ragged row must equal single-token decode attention over
+    the same cache state (the mixed step's decode-lane guarantee)."""
+    from opsagent_tpu.ops.attention import (
+        paged_decode_attention, paged_ragged_attention,
+    )
+
+    rng = np.random.default_rng(12)
+    B, S, H, K, D, P, MaxP = 2, 4, 4, 2, 32, 4, 6
+    start = [7, 14]
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B, S, H, K, D, P, MaxP, num_pages=B * MaxP + 2,
+        start=start, q_lens=[1, 1],
+    )
+    ragged = paged_ragged_attention(q, k_pages, v_pages, table, st, ql)
+    dec = paged_decode_attention(
+        q[:, 0], k_pages, v_pages, table, st + 1
+    )
+    np.testing.assert_allclose(
+        np.asarray(ragged)[:, 0], np.asarray(dec), rtol=2e-5, atol=2e-5
+    )
