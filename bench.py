@@ -102,11 +102,50 @@ def metrics_snapshot() -> dict:
         return {}
 
 
+def slo_verdicts() -> dict:
+    """The declared-SLO verdicts (obs.slo) over this run's histograms —
+    the same evaluation ``GET /api/slo`` serves and ``opsagent
+    slo-check --bench`` reads back out of the BENCH JSON."""
+    try:
+        from opsagent_tpu.obs import slo
+
+        return slo.evaluate()
+    except Exception:  # noqa: BLE001 - telemetry must never sink a bench
+        return {}
+
+
+def slo_strict() -> bool:
+    return (
+        "--slo-strict" in sys.argv[1:]
+        or os.environ.get("OPSAGENT_BENCH_SLO_STRICT", "") not in ("", "0")
+    )
+
+
+def exit_if_slo_breach(slo: dict) -> None:
+    """Under ``--slo-strict`` (or OPSAGENT_BENCH_SLO_STRICT=1), a
+    breached declared SLO fails the bench process — the CI-gate form of
+    the watchdog. Called AFTER the result line is printed, so the number
+    is never lost to the verdict."""
+    if not slo_strict():
+        return
+    failed = [
+        v["name"] for v in (slo or {}).get("slos", [])
+        if v.get("pass") is False
+    ]
+    if failed:
+        log(f"bench: --slo-strict: SLO breach: {', '.join(failed)}")
+        sys.exit(3)
+
+
 def main() -> None:
     # Plain `python bench.py` orchestrates the presets in subprocesses
     # (guaranteed-fast number first, headline after, sessions last, all
     # under one wall-clock budget). Explicit OPSAGENT_BENCH_MODEL/MODE
     # requests — and orchestrator children — run a single config inline.
+    if slo_strict():
+        # Children are spawned without argv: carry the flag in the env so
+        # every stage applies the same gate.
+        os.environ["OPSAGENT_BENCH_SLO_STRICT"] = "1"
     if (
         os.environ.get("_OPSAGENT_BENCH_CHILD")
         or os.environ.get("OPSAGENT_BENCH_MODEL")
@@ -411,6 +450,9 @@ def run_orchestrated() -> None:
         extra["cold_restart_warmup_s"] = ce.get("warmup_s")
     out = dict(headline, extra=extra)
     print(json.dumps(out), flush=True)
+    # The children already gated themselves; re-check the headline's
+    # folded verdicts so the ORCHESTRATOR's exit code is the CI signal.
+    exit_if_slo_breach(extra.get("slo") or {})
 
 
 def run_single() -> None:
@@ -641,8 +683,10 @@ def run_single() -> None:
             "decode_block": eng.cfg.decode_block,
             "page_size": eng.cfg.page_size,
             "metrics": metrics_snapshot(),
+            "slo": slo_verdicts(),
         },
     }), flush=True)
+    exit_if_slo_breach(slo_verdicts())
 
 
 def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
@@ -735,10 +779,12 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "metrics": metrics_snapshot(),
+            "slo": slo_verdicts(),
         },
     }), flush=True)
     log_perf_table()
     stack.close()
+    exit_if_slo_breach(slo_verdicts())
 
 
 def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
@@ -873,9 +919,11 @@ def run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "metrics": metrics_snapshot(),
+            "slo": slo_verdicts(),
         },
     }), flush=True)
     log_perf_table()
+    exit_if_slo_breach(slo_verdicts())
 
 
 def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
@@ -1028,12 +1076,14 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "metrics": metrics_snapshot(),
+            "slo": slo_verdicts(),
         },
     }), flush=True)
     if errors:
         log(f"bench[agent]: first error: {errors[0]}")
     log_perf_table()
     stack.close()
+    exit_if_slo_breach(slo_verdicts())
 
 
 if __name__ == "__main__":

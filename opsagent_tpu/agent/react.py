@@ -25,6 +25,7 @@ handler's 4-stage parse ladder, the CLI) extract ``final_answer`` themselves.
 from __future__ import annotations
 
 import re
+import time
 from typing import Any
 
 from .. import obs
@@ -183,19 +184,35 @@ def _react_loop(
         if name and name in tools:
             if verbose:
                 log.info("tool %s input=%r", name, tool_input[:200])
+            t_tool = time.perf_counter()
+
+            def _tool_flight(outcome: str, error: str = "") -> None:
+                ev = {
+                    "tool": name, "outcome": outcome,
+                    "duration_ms": round(
+                        (time.perf_counter() - t_tool) * 1e3, 3
+                    ),
+                }
+                if error:
+                    ev["error"] = error
+                obs.flight.record("tool_exec", **ev)
+
             try:
                 with ps.timer(f"agent.tool.{name}"), \
                         obs.span("tool_exec", tool=name):
                     observation = tools[name](tool_input)
                 obs.TOOL_CALLS.inc(tool=name, outcome="ok")
+                _tool_flight("ok")
             except ToolError as e:
                 obs.TOOL_CALLS.inc(tool=name, outcome="error")
+                _tool_flight("error", str(e))
                 observation = (
                     f"Tool {name} failed with error {e}. "
                     "Considering refine the inputs for the tool."
                 )
             except Exception as e:  # noqa: BLE001 - tool bugs become observations
                 obs.TOOL_CALLS.inc(tool=name, outcome="error")
+                _tool_flight("error", str(e))
                 observation = (
                     f"Tool {name} failed with error {e}. "
                     "Considering refine the inputs for the tool."

@@ -80,6 +80,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("version", help="print version")
 
+    sc = sub.add_parser(
+        "slo-check",
+        help="evaluate the declared serving SLOs (bench/CI gate: exit 0 "
+             "pass, 1 breach, 2 no data)",
+    )
+    sc.add_argument(
+        "--url", default="",
+        help="base URL of a running server; fetches GET /api/slo",
+    )
+    sc.add_argument(
+        "--bench", default="",
+        help="BENCH json/jsonl file; reads the extra.slo verdicts "
+             "bench.py folded in",
+    )
+
     se = sub.add_parser("serve-engine", help="run the TPU serving engine (OpenAI-compatible)")
     se.add_argument("--port", type=int, default=8000)
     se.add_argument("--host", default="0.0.0.0")
@@ -163,6 +178,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "version":
         print(f"opsagent {VERSION}")
         return 0
+
+    if args.command == "slo-check":
+        from .slocheck import run_slo_check
+
+        return run_slo_check(url=args.url, bench=args.bench)
 
     if args.command == "server":
         # Precedence: flag > env (how k8s Secrets are injected,

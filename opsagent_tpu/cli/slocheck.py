@@ -1,0 +1,108 @@
+"""``opsagent slo-check`` — the CLI face of the SLO watchdog, usable as
+a bench/CI gate.
+
+Three sources, checked in this order:
+
+- ``--url http://host:port`` — fetch ``GET /api/slo`` from a running
+  server (agent server or serving engine; the endpoint is public);
+- ``--bench BENCH.json`` — read the ``extra.slo`` verdicts bench.py folds
+  into its result line (accepts a single JSON object or a JSONL file —
+  the last line carrying ``extra.slo`` wins);
+- neither — evaluate the declared SLOs against THIS process's metrics
+  registry (useful after an in-process bench/library run).
+
+Exit codes: 0 = every evaluated SLO passes, 1 = at least one breach,
+2 = no verdicts available (unreachable server, empty registry, bench
+line without ``extra.slo``) — distinct so CI can tell "failing" from
+"not measured".
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any
+
+
+def _fetch_url(url: str, timeout_s: float = 10.0) -> dict[str, Any]:
+    from urllib.request import urlopen
+
+    base = url.rstrip("/")
+    if not base.endswith("/api/slo"):
+        base += "/api/slo"
+    with urlopen(base, timeout=timeout_s) as resp:  # noqa: S310 - operator URL
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _read_bench(path: str) -> dict[str, Any] | None:
+    """The last ``extra.slo`` block in a BENCH json/jsonl file."""
+    found: dict[str, Any] | None = None
+    with open(path) as f:
+        text = f.read()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    for ln in lines:
+        try:
+            d = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        slo = d.get("extra", {}).get("slo") if isinstance(d, dict) else None
+        if slo:
+            found = slo
+    if found is None and len(lines) != 1:
+        # Maybe a pretty-printed single JSON document.
+        try:
+            d = json.loads(text)
+            found = d.get("extra", {}).get("slo")
+        except (json.JSONDecodeError, AttributeError):
+            pass
+    return found
+
+
+def _format(verdicts: dict[str, Any]) -> str:
+    rows = [
+        f"{'slo':<22} {'value':>12} {'target':>10} {'burn':>7}  verdict"
+    ]
+    for v in verdicts.get("slos", []):
+        value = v.get("value")
+        burn = v.get("burn_rate")
+        ok = v.get("pass")
+        verdict = "PASS" if ok else ("FAIL" if ok is False else "NO DATA")
+        rows.append(
+            f"{v.get('name', '?'):<22} "
+            f"{value if value is not None else '-':>12} "
+            f"{v.get('target', '-'):>10} "
+            f"{burn if burn is not None else '-':>7}  "
+            f"{verdict} ({v.get('unit', '')})"
+        )
+    return "\n".join(rows)
+
+
+def run_slo_check(url: str = "", bench: str = "") -> int:
+    try:
+        if url:
+            verdicts = _fetch_url(url)
+        elif bench:
+            verdicts = _read_bench(bench)
+            if verdicts is None:
+                print(
+                    f"slo-check: {bench} carries no extra.slo block",
+                    file=sys.stderr,
+                )
+                return 2
+        else:
+            from .. import obs
+
+            verdicts = obs.slo.evaluate()
+    except Exception as e:  # noqa: BLE001 - CI gate: report, exit 2
+        print(f"slo-check: unavailable: {e}", file=sys.stderr)
+        return 2
+    print(_format(verdicts))
+    slos = verdicts.get("slos", [])
+    if not slos or all(v.get("pass") is None for v in slos):
+        print("slo-check: no SLO has data yet", file=sys.stderr)
+        return 2
+    failed = [v["name"] for v in slos if v.get("pass") is False]
+    if failed:
+        print(f"slo-check: BREACH: {', '.join(failed)}", file=sys.stderr)
+        return 1
+    return 0

@@ -443,8 +443,18 @@ def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
     except Exception:  # pragma: no cover - accessor removed upstream
         mesh = None
     if mesh is None:
-        am = jax.sharding.get_abstract_mesh()
-        if getattr(am, "axis_names", ()):
+        am = None
+        try:
+            am = jax.sharding.get_abstract_mesh()
+        except AttributeError:
+            # jax 0.4.x keeps the accessor private; same thread-local.
+            try:
+                from jax._src.mesh import get_abstract_mesh
+
+                am = get_abstract_mesh()
+            except Exception:  # pragma: no cover - accessor moved again
+                am = None
+        if am is not None and getattr(am, "axis_names", ()):
             mesh = am
     if (
         mesh is not None

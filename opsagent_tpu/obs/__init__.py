@@ -40,6 +40,41 @@ from .trace import (  # noqa: F401
 
 _reg = get_registry()
 
+# -- flight recorder + compile watchdog + SLO watchdog ------------------------
+COMPILES = _reg.counter(
+    "opsagent_xla_compiles_total",
+    "Real XLA backend compiles by phase (startup/warmup/serving); "
+    "phase=serving after a completed warmup is the anomaly",
+    labelnames=("phase",),
+)
+COMPILE_SECONDS = _reg.histogram(
+    "opsagent_xla_compile_seconds",
+    "XLA backend compile wall time per executable, by phase",
+    labelnames=("phase",),
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 120.0),
+)
+POST_WARMUP_COMPILES = _reg.gauge(
+    "opsagent_post_warmup_compiles",
+    "XLA compiles AFTER a completed warmup — the live form of the "
+    "zero-post-warmup-compiles invariant (healthy value: 0)",
+)
+# Materialize the healthy value: an absent gauge and "zero anomalous
+# compiles" must not look the same on a scrape.
+POST_WARMUP_COMPILES.set(0.0)
+COMPILE_CACHE_EVENTS = _reg.counter(
+    "opsagent_compile_cache_events_total",
+    "Persistent compilation cache bookkeeping events "
+    "(jax.monitoring /jax/compilation_cache/*)",
+    labelnames=("event",),
+)
+ANOMALIES = _reg.counter(
+    "opsagent_anomalies_total",
+    "Flight-recorder anomaly triggers by reason (each one dumps the "
+    "event ring to JSONL, rate-limited)",
+    labelnames=("reason",),
+)
+
 # -- engine step telemetry ----------------------------------------------------
 TTFT_SECONDS = _reg.histogram(
     "opsagent_ttft_seconds",
@@ -147,3 +182,15 @@ def metrics_snapshot() -> dict:
     """Compact dict of every sample (bench.py folds this into BENCH
     JSON)."""
     return get_registry().snapshot()
+
+
+# Imported AFTER the instrument handles exist: both modules record into
+# them. ``flight`` owns the event ring + compile watchdog, ``slo`` the
+# declared-objective evaluation; the watchdog's listeners register at
+# import so no compile anywhere in the process escapes the count, and the
+# SLO gauges join the scrape as a collector.
+from . import flight  # noqa: E402,F401
+from . import slo  # noqa: E402,F401
+
+flight.install_compile_watchdog()
+_reg.add_collector(lambda: slo.get_watchdog().collect())

@@ -1,0 +1,368 @@
+"""Serving flight recorder: a bounded ring of structured engine /
+scheduler / server events, auto-dumped to JSONL on anomaly.
+
+PR 1's metrics answer "how slow" (histograms, counters) and traces answer
+"where inside one request" — neither answers "what happened in the seconds
+BEFORE it got slow": what the dispatch composition was, whether a
+post-warmup compile landed, whether the engine restarted, which requests
+were admitted and in what order. The round-5 verdict's two standing
+failures (7.5 s sessions p50 TTFT, 23 s cold restart) were both diagnosed
+after the fact from scattered logs; the flight recorder keeps that
+context resident so the diagnosis is one endpoint read.
+
+Design:
+
+- **Bounded + cheap**: one ``deque(maxlen=...)`` append under a lock per
+  event; events are plain dicts (monotonic ``ts`` for ordering, wall
+  ``wall`` for correlating with external logs). The hot loop records one
+  event per *device dispatch*, not per token, so the overhead is noise
+  next to the dispatch itself.
+- **Recorded from every layer**: admission / dispatch composition /
+  preemption / prefix eviction / finish (engine), restart + request
+  errors (scheduler), tool execution (agent loop), compile events
+  (compile watchdog below).
+- **Dumpable**: ``GET /api/debug/flight`` on both servers returns the
+  ring; on anomaly the ring is written to a JSONL file under
+  ``$OPSAGENT_FLIGHT_DIR`` (default ``logs/flight``) so a crash or
+  restart cannot lose the context that explains it.
+
+Anomaly triggers (each rate-limited so a storm cannot fill the disk):
+
+- a **post-warmup XLA compile** (the r04 sessions pathology: serving
+  windows silently paying ~1 s remote-compile round trips);
+- **TTFT over threshold** (``$OPSAGENT_SLO_TTFT_MS``, default 500 — the
+  north-star p50 target doubles as the per-request alarm line);
+- an **engine restart** (slice-restart recovery engaged);
+- a **request error** (admission failure / stream-callback death).
+
+The compile watchdog also lives here: ``jax.monitoring`` listeners feed
+labeled compile counters/histograms so "zero post-warmup compiles" is a
+live ``/metrics`` gauge (``opsagent_post_warmup_compiles``) instead of a
+test-only assertion. ``Engine.warmup`` wraps its body in
+``warmup_phase()``; compiles before the first completed warmup count as
+phase "startup", compiles inside it as "warmup", and anything after is
+"serving" — an anomaly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Iterator
+
+from ..utils.logger import get_logger
+
+log = get_logger("obs.flight")
+
+_ENV_DIR = "OPSAGENT_FLIGHT_DIR"
+_ENV_CAPACITY = "OPSAGENT_FLIGHT_CAPACITY"
+_ENV_DUMP_INTERVAL = "OPSAGENT_FLIGHT_DUMP_INTERVAL_S"
+_ENV_TTFT_MS = "OPSAGENT_SLO_TTFT_MS"
+
+DEFAULT_CAPACITY = 2048
+DEFAULT_DUMP_INTERVAL_S = 5.0
+
+
+def flight_dir() -> str:
+    return os.environ.get(_ENV_DIR) or "logs/flight"
+
+
+def ttft_threshold_s() -> float:
+    """The per-request TTFT alarm line in seconds (the p50 SLO target
+    doubles as the anomaly trigger: any single request past it is worth a
+    ring dump, because p50 breaches are made of such requests)."""
+    try:
+        return float(os.environ.get(_ENV_TTFT_MS, "500")) / 1e3
+    except ValueError:
+        return 0.5
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring with anomaly-triggered JSONL dumps."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        dump_interval_s: float | None = None,
+    ):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(_ENV_CAPACITY, ""))
+            except ValueError:
+                capacity = 0
+            capacity = capacity or DEFAULT_CAPACITY
+        self.capacity = capacity
+        self.dump_interval_s = (
+            DEFAULT_DUMP_INTERVAL_S if dump_interval_s is None
+            else dump_interval_s
+        )
+        self._lock = threading.Lock()
+        self._ring: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._seq = 0              # monotonically increasing event id
+        self._dropped = 0          # events evicted by the ring bound
+        self._anomalies = 0
+        self._last_dump_s = 0.0    # perf_counter of the last JSONL dump
+        self.last_dump_path: str | None = None
+
+    # -- recording ---------------------------------------------------------
+    def record(self, kind: str, **fields: Any) -> dict[str, Any]:
+        """Append one event. ``fields`` must be JSON-serializable (the
+        dump path str()s anything that is not, rather than losing the
+        ring to one exotic attr)."""
+        ev = {
+            "ts": time.perf_counter(),
+            "wall": time.time(),
+            "kind": kind,
+        }
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["id"] = self._seq
+            if len(self._ring) == self.capacity:
+                self._dropped += 1
+            self._ring.append(ev)
+        return ev
+
+    def anomaly(self, reason: str, **fields: Any) -> str | None:
+        """Record an anomaly event and dump the ring to JSONL (rate-
+        limited). Returns the dump path, or None when rate-limited /
+        dump-failed. Never raises: the flight recorder must not add a
+        failure mode to the path it is observing."""
+        from . import ANOMALIES
+
+        ev = self.record("anomaly", reason=reason, **fields)
+        try:
+            ANOMALIES.inc(reason=reason)
+        except Exception:  # noqa: BLE001
+            pass
+        now = time.perf_counter()
+        with self._lock:
+            if now - self._last_dump_s < self.dump_interval_s:
+                return None
+            self._last_dump_s = now
+        try:
+            return self._dump_jsonl(reason, ev)
+        except Exception as e:  # noqa: BLE001 - observability must not kill serving
+            log.warning("flight dump failed: %s", e)
+            return None
+
+    def _dump_jsonl(self, reason: str, trigger: dict[str, Any]) -> str:
+        d = flight_dir()
+        os.makedirs(d, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        path = os.path.join(
+            d, f"flight-{stamp}-{trigger['id']}-{_slug(reason)}.jsonl"
+        )
+        events = self.snapshot()
+        head = {
+            "kind": "dump_header",
+            "reason": reason,
+            "trigger_id": trigger["id"],
+            "wall": time.time(),
+            "events": len(events),
+            "dropped": self._dropped,
+            "capacity": self.capacity,
+        }
+        with open(path, "w") as f:
+            f.write(json.dumps(head, default=str) + "\n")
+            for ev in events:
+                f.write(json.dumps(ev, default=str) + "\n")
+        self.last_dump_path = path
+        log.warning(
+            "flight recorder dumped %d events to %s (reason: %s)",
+            len(events), path, reason,
+        )
+        return path
+
+    # -- reading -----------------------------------------------------------
+    def snapshot(
+        self, n: int | None = None, kind: str | None = None
+    ) -> list[dict[str, Any]]:
+        """The newest-last event list; ``n`` caps to the newest n after
+        the optional kind filter."""
+        with self._lock:
+            events: Iterator[dict[str, Any]] | list = list(self._ring)
+        if kind:
+            events = [e for e in events if e.get("kind") == kind]
+        if n is not None and n >= 0:
+            events = list(events)[-n:]
+        return list(events)
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "events": len(self._ring),
+                "capacity": self.capacity,
+                "total_recorded": self._seq,
+                "dropped": self._dropped,
+                "last_dump_path": self.last_dump_path,
+            }
+
+    def reset(self) -> None:
+        """Test-isolation hook: clear the ring and the dump rate limit."""
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self._dropped = 0
+            self._last_dump_s = 0.0
+            self.last_dump_path = None
+
+
+_recorder: FlightRecorder | None = None
+_recorder_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def record(kind: str, **fields: Any) -> None:
+    """Module-level convenience onto the process-wide recorder."""
+    get_recorder().record(kind, **fields)
+
+
+def anomaly(reason: str, **fields: Any) -> str | None:
+    return get_recorder().anomaly(reason, **fields)
+
+
+def _slug(s: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "-" for c in s)[:48]
+
+
+def request_id_of(span: Any) -> str | None:
+    """The request ID behind an engine/scheduler span handle (obs.trace
+    Span), or None. Events carry it so a dump can be filtered to one
+    request's life."""
+    try:
+        return span.trace.request_id if span is not None else None
+    except AttributeError:
+        return None
+
+
+# -- compile watchdog ---------------------------------------------------------
+#
+# jax.monitoring fires one duration event per real backend compile
+# (never on jit-cache hits) and plain events for the persistent
+# compilation cache's hit/miss bookkeeping. The listeners below turn
+# those into labeled /metrics instruments and flight-ring events, and
+# flag any compile that lands AFTER a completed warmup as an anomaly —
+# the r04 sessions pathology (serving windows paying ~1 s remote-compile
+# round trips) becomes a dump + counter instead of log archaeology.
+
+_COMPILE_DURATION_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_EVENT_PREFIX = "/jax/compilation_cache/"
+
+_warmup_depth = 0          # >0 while Engine.warmup() runs (any engine)
+_warmed = False            # at least one warmup completed in this process
+_watch_lock = threading.Lock()
+_listeners_installed = False
+
+
+def compile_phase() -> str:
+    """Phase label for a compile landing now: "warmup" inside a warmup
+    call, "serving" after the first completed warmup (the anomalous
+    case), "startup" before any warmup (unwarmed engines compile lazily
+    by design)."""
+    with _watch_lock:
+        if _warmup_depth > 0:
+            return "warmup"
+        return "serving" if _warmed else "startup"
+
+
+class warmup_phase:
+    """Context manager bracketing Engine.warmup(): compiles inside count
+    as phase "warmup"; on exit the process is marked warmed, so later
+    compiles are "serving" anomalies. Re-entrant across engines."""
+
+    def __enter__(self) -> "warmup_phase":
+        global _warmup_depth
+        with _watch_lock:
+            _warmup_depth += 1
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        global _warmup_depth, _warmed
+        with _watch_lock:
+            _warmup_depth = max(0, _warmup_depth - 1)
+            if exc[0] is None:
+                _warmed = True
+
+
+def warmed() -> bool:
+    with _watch_lock:
+        return _warmed
+
+
+def reset_compile_watchdog() -> None:
+    """Test-isolation hook: forget the warmed state so one test's warmup
+    cannot turn every later test's lazy compile into an anomaly."""
+    global _warmup_depth, _warmed
+    with _watch_lock:
+        _warmup_depth = 0
+        _warmed = False
+
+
+def _on_duration_event(name: str, *args: Any, **kwargs: Any) -> None:
+    if name != _COMPILE_DURATION_EVENT:
+        return
+    duration = 0.0
+    if args:
+        try:
+            duration = float(args[0])
+        except (TypeError, ValueError):
+            duration = 0.0
+    phase = compile_phase()
+    try:
+        from . import COMPILE_SECONDS, COMPILES, POST_WARMUP_COMPILES
+
+        COMPILES.inc(phase=phase)
+        COMPILE_SECONDS.observe(duration, phase=phase)
+        if phase == "serving":
+            POST_WARMUP_COMPILES.inc()
+    except Exception:  # noqa: BLE001 - never break jax's compile path
+        return
+    rec = get_recorder()
+    rec.record("compile", phase=phase, duration_s=round(duration, 4))
+    if phase == "serving":
+        rec.anomaly("post_warmup_compile", duration_s=round(duration, 4))
+
+
+def _on_plain_event(name: str, **kwargs: Any) -> None:
+    if not name.startswith(_CACHE_EVENT_PREFIX):
+        return
+    try:
+        from . import COMPILE_CACHE_EVENTS
+
+        # e.g. cache_hits / cache_misses / task_disabled_cache
+        COMPILE_CACHE_EVENTS.inc(event=name[len(_CACHE_EVENT_PREFIX):])
+    except Exception:  # noqa: BLE001
+        return
+
+
+def install_compile_watchdog() -> None:
+    """Register the jax.monitoring listeners once per process.
+    jax.monitoring has no public deregistration, so this must be
+    idempotent; the listeners themselves are no-ops for event names they
+    do not own."""
+    global _listeners_installed
+    with _watch_lock:
+        if _listeners_installed:
+            return
+        _listeners_installed = True
+    try:
+        import jax
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_duration_event
+        )
+        jax.monitoring.register_event_listener(_on_plain_event)
+    except Exception as e:  # noqa: BLE001 - jax-less import contexts
+        log.warning("compile watchdog unavailable: %s", e)

@@ -42,8 +42,11 @@ def _local_ring_attention(
     v: jax.Array,        # [B, S_l, K, D]
     lengths: jax.Array,  # [B] valid GLOBAL lengths (right padding beyond)
     axis: str,
+    sp: int,             # static axis size (mesh.shape[axis]): the ring
+                         # step count and perm table need a Python int,
+                         # and jax.lax.axis_size only exists in newer jax
+                         # than this container ships (0.4.37)
 ) -> jax.Array:
-    sp = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, S_l, H, D = q.shape
     K = k.shape[2]
@@ -116,7 +119,9 @@ def make_ring_attention(
     Heads stay tensor-parallel over "tp"; batch over "dp"."""
     spec = P("dp", "sp", "tp", None)
     len_spec = P("dp")  # lengths replicated over sp/tp, batch over dp
-    local = functools.partial(_local_ring_attention, axis=axis)
+    local = functools.partial(
+        _local_ring_attention, axis=axis, sp=int(mesh.shape[axis])
+    )
     try:
         from jax import shard_map
 

@@ -35,7 +35,10 @@ _CORS_HEADERS = {
 
 Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 
-PUBLIC_PATHS = {"/login", "/api/version", "/healthz", "/metrics"}
+# /api/slo is public like /metrics: both are read-only health summaries a
+# CI gate / prober hits without credentials. The flight ring and profile
+# capture stay behind the JWT — event attrs can carry request payloads.
+PUBLIC_PATHS = {"/login", "/api/version", "/healthz", "/metrics", "/api/slo"}
 
 
 @web.middleware
@@ -135,11 +138,17 @@ def build_app() -> web.Application:
     app.router.add_post("/api/perf/reset", handlers.perf_reset)
     app.router.add_get("/metrics", handlers.metrics)
     app.router.add_get("/api/trace/{request_id}", handlers.trace_get)
+    app.router.add_get("/api/debug/flight", handlers.flight_get)
+    app.router.add_get("/api/slo", handlers.slo_get)
+    app.router.add_post("/api/debug/profile", handlers.profile_capture)
     return app
 
 
 def run_server(host: str = "0.0.0.0", port: int = 8080) -> None:
     app = build_app()
+    # Continuous SLO evaluation behind GET /api/slo and the
+    # opsagent_slo_* scrape gauges.
+    obs.slo.get_watchdog().start()
 
     async def _announce(_: web.Application) -> None:
         # Logged from on_startup so the line appears only once the socket is

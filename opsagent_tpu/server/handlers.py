@@ -317,3 +317,51 @@ async def trace_get(request: web.Request) -> web.Response:
     if t is None:
         return web.json_response({"error": "unknown request_id"}, status=404)
     return web.json_response(t)
+
+
+async def flight_get(request: web.Request) -> web.Response:
+    """The flight recorder's event ring (newest last): admissions,
+    dispatch compositions, tool executions, compiles, anomalies.
+    ``?n=`` caps the event count, ``?kind=`` filters by event kind."""
+    try:
+        n = int(request.query["n"]) if "n" in request.query else None
+    except ValueError:
+        return web.json_response({"error": "n must be an integer"}, status=400)
+    rec = obs.flight.get_recorder()
+    return web.json_response({
+        **rec.stats(),
+        "events": rec.snapshot(n=n, kind=request.query.get("kind")),
+    })
+
+
+async def slo_get(request: web.Request) -> web.Response:
+    """Declared SLO verdicts (pass/fail + burn rate), evaluated live from
+    the same histograms ``/metrics`` exposes."""
+    return web.json_response(obs.slo.evaluate())
+
+
+async def profile_capture(request: web.Request) -> web.Response:
+    """POST /api/debug/profile?seconds=N — capture a jax.profiler device
+    trace around live traffic (requires --profile-dir /
+    $OPSAGENT_PROFILE_DIR; see serving/api.py for the engine-side twin)."""
+    from ..utils.profiling import timed_capture
+
+    try:
+        seconds = float(request.query.get("seconds", "5"))
+    except ValueError:
+        return web.json_response(
+            {"error": "seconds must be a number"}, status=400
+        )
+    try:
+        logdir = await asyncio.get_running_loop().run_in_executor(
+            None, timed_capture, seconds
+        )
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except RuntimeError as e:
+        return web.json_response({"error": str(e)}, status=403)
+    except Exception as e:  # noqa: BLE001 - already tracing / bad dir
+        return web.json_response({"error": str(e)}, status=409)
+    return web.json_response(
+        {"status": "captured", "seconds": seconds, "logdir": logdir}
+    )

@@ -796,12 +796,69 @@ def build_engine_app(stack: ServingStack):
             )
         return web.json_response(t)
 
+    async def flight_get(request: web.Request) -> web.Response:
+        # The flight recorder's event ring: what the engine/scheduler
+        # actually did, newest last. ?n= caps the event count, ?kind=
+        # filters (admission/dispatch/compile/anomaly/...).
+        try:
+            n = int(request.query["n"]) if "n" in request.query else None
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "n must be an integer"}}, status=400
+            )
+        rec = obs.flight.get_recorder()
+        return web.json_response({
+            **rec.stats(),
+            "events": rec.snapshot(n=n, kind=request.query.get("kind")),
+        })
+
+    async def slo_get(request: web.Request) -> web.Response:
+        return web.json_response(obs.slo.evaluate())
+
+    async def profile_capture(request: web.Request) -> web.Response:
+        # POST /api/debug/profile?seconds=N — capture a jax.profiler
+        # device trace around LIVE traffic for N seconds (blocking in a
+        # worker thread; requests keep flowing), so a TPU window can
+        # attribute the full-stack tax on chip without a bench harness.
+        from ..utils.profiling import timed_capture
+
+        try:
+            seconds = float(request.query.get("seconds", "5"))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "seconds must be a number"}},
+                status=400,
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            logdir = await loop.run_in_executor(
+                None, timed_capture, seconds
+            )
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=400
+            )
+        except RuntimeError as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=403
+            )
+        except Exception as e:  # noqa: BLE001 - already tracing / bad dir
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=409
+            )
+        return web.json_response({
+            "status": "captured", "seconds": seconds, "logdir": logdir,
+        })
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", completions)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/trace/{request_id}", trace_get)
+    app.router.add_get("/api/debug/flight", flight_get)
+    app.router.add_get("/api/slo", slo_get)
+    app.router.add_post("/api/debug/profile", profile_capture)
     app.router.add_post("/v1/profile/start", profile_start)
     app.router.add_post("/v1/profile/stop", profile_stop)
     return app
@@ -853,6 +910,10 @@ def run_engine_server(
     stack = ServingStack(engine)
     install_stack(model_name, stack)
     app = build_engine_app(stack)
+    # Continuous SLO evaluation (GET /api/slo serves the same watchdog):
+    # keeps the throughput rate window warm and logs breach transitions
+    # into the flight ring even when nobody scrapes.
+    obs.slo.get_watchdog().start()
 
     async def _announce(_) -> None:
         log.info("serving engine listening on %s:%d (model=%s)", host, port, model_name)

@@ -667,7 +667,10 @@ class Engine:
         t0 = time.perf_counter()
         B = self.cfg.max_batch_size
         MaxP = self.cfg.max_pages_per_seq
-        with self.lock, self.mesh_ctx():
+        # Compile-watchdog phase bracket: compiles inside count as
+        # "warmup"; once any warmup completes, a compile during serving is
+        # an anomaly (ring dump + opsagent_post_warmup_compiles).
+        with obs.flight.warmup_phase(), self.lock, self.mesh_ctx():
             # Re-warming a LIVE engine: settle in-flight decode state first,
             # exactly like the legacy step path (warmup's throwaway carries
             # would otherwise desync lanes still referenced by pulls).
@@ -836,6 +839,7 @@ class Engine:
         dt = time.perf_counter() - t0
         log.info("engine warmup[%s]: programs compiled in %.1f s", level, dt)
         get_perf_stats().record_metric("engine.warmup", dt * 1e3, "ms")
+        obs.flight.record("warmup", level=level, seconds=round(dt, 3))
         return dt
 
     # -- bucketing ---------------------------------------------------------
@@ -926,6 +930,11 @@ class Engine:
                     "engine.prefix_hit_tokens", matched, "tok"
                 )
                 obs.PREFIX_HIT_TOKENS.inc(matched)
+            obs.flight.record(
+                "admission", seq_id=seq_id, prompt_tokens=n,
+                prefix_hit_tokens=matched,
+                request_id=obs.flight.request_id_of(trace),
+            )
             self._observe_occupancy()
             return seq_id
 
@@ -1002,6 +1011,11 @@ class Engine:
                     "engine.prefill_tokens", int(sum(chunks)), "tok"
                 )
                 obs.PREFILL_TOKENS.inc(int(sum(chunks)))
+                obs.flight.record(
+                    "dispatch", op="prefill_batch", seq_ids=list(seq_ids),
+                    bucket=bucket, rows=len(seq_ids),
+                    prefill_tokens=int(sum(chunks)),
+                )
                 out: dict[int, Any] = {}
                 finished_rows = [
                     i for i, (seq, d, c) in enumerate(zip(seqs, dones, chunks))
@@ -1121,6 +1135,11 @@ class Engine:
                 perf = get_perf_stats()
                 perf.record_metric("engine.prefill_tokens", chunk, "tok")
                 obs.PREFILL_TOKENS.inc(chunk)
+                obs.flight.record(
+                    "dispatch", op="prefill_chunk", seq_id=seq_id,
+                    bucket=bucket, prefill_tokens=chunk,
+                    prompt_done=done, prompt_total=n,
+                )
                 if done < n:
                     self._prefilling[seq_id] = done
                     return False
@@ -1227,6 +1246,7 @@ class Engine:
                     s.done = True
                     s.finish_reason = "length"
                     obs.PREEMPTIONS.inc()
+                    obs.flight.record("preemption", seq_id=s.seq_id)
                     log.warning(
                         "seq %d truncated: KV page budget exhausted",
                         s.seq_id,
@@ -1319,6 +1339,13 @@ class Engine:
             record_mixed_dispatch(
                 decode_rows=len(decode),
                 prefill_tokens=n_prefill,
+                budget=self.cfg.max_step_tokens,
+            )
+            obs.flight.record(
+                "dispatch", op="mixed",
+                decode_seq_ids=[s.seq_id for s in decode],
+                prefill_seq_ids=[sid for sid, *_ in chunk_info],
+                bucket=int(S), prefill_tokens=n_prefill,
                 budget=self.cfg.max_step_tokens,
             )
             for i, s in enumerate(decode):
@@ -1512,14 +1539,30 @@ class Engine:
         ev = self.alloc.evictions
         if ev > self._evictions_seen:
             obs.PREFIX_EVICTIONS.inc(ev - self._evictions_seen)
+            obs.flight.record(
+                "prefix_eviction", count=ev - self._evictions_seen
+            )
             self._evictions_seen = ev
 
     def _first_token_obs(self, seq: Sequence) -> None:
         """Prefill finished and the first token was sampled: observe TTFT,
         record the prefill span, and open the request's decode span (per-
         dispatch block spans attach under it; closed when the sequence
-        finishes)."""
+        finishes). A TTFT past the SLO threshold is a flight-recorder
+        anomaly: the ring dump holds the admissions and dispatch
+        compositions of the seconds leading up to the slow first token."""
         obs.TTFT_SECONDS.observe(seq.ttft_s)
+        ttft_ms = round(seq.ttft_s * 1e3, 3)
+        rid = obs.flight.request_id_of(seq.trace)
+        obs.flight.record(
+            "ttft", seq_id=seq.seq_id, ttft_ms=ttft_ms, request_id=rid
+        )
+        thr = obs.flight.ttft_threshold_s()
+        if thr > 0 and seq.ttft_s > thr:
+            obs.flight.anomaly(
+                "ttft_breach", seq_id=seq.seq_id, ttft_ms=ttft_ms,
+                threshold_ms=round(thr * 1e3, 3), request_id=rid,
+            )
         now = time.perf_counter()
         if seq.trace is not None:
             seq.trace.child(
@@ -1744,6 +1787,7 @@ class Engine:
                         s.done = True
                         s.finish_reason = "length"
                         obs.PREEMPTIONS.inc()
+                        obs.flight.record("preemption", seq_id=s.seq_id)
                         log.warning(
                             "seq %d truncated: KV page budget exhausted",
                             s.seq_id,
@@ -1812,6 +1856,10 @@ class Engine:
             from .decode_loop import record_dispatch
 
             record_dispatch("single", rows=len(running), steps=1)
+            obs.flight.record(
+                "dispatch", op="decode_single",
+                seq_ids=[s.seq_id for s in running],
+            )
             out: dict[int, int] = {}
             first_exc: BaseException | None = None
             for i, s in enumerate(running):
@@ -2028,6 +2076,7 @@ class Engine:
                     s.done = True
                     s.finish_reason = "length"
                     obs.PREEMPTIONS.inc()
+                    obs.flight.record("preemption", seq_id=sid)
                     self.alloc.truncate(sid, self._host_written(s))
                     self._free_lane(sid)
                     override[lane] = False
@@ -2175,6 +2224,12 @@ class Engine:
                 rows=int(np.count_nonzero(budgets)),
                 steps=int(budgets.max()),
             )
+            obs.flight.record(
+                "dispatch", op="spec" if speculate else "decode_block",
+                seq_ids=[sid for sid, b in zip(lane_seqs, budgets)
+                         if sid is not None and b],
+                steps=int(budgets.max()),
+            )
             self._inflight.append((toks, lane_seqs, budgets, counts, t_disp))
             for sid, b in zip(lane_seqs, budgets):
                 if sid is not None and b:
@@ -2214,6 +2269,11 @@ class Engine:
         with self.lock:
             seq = self.sequences.pop(seq_id)
             self.alloc.free(seq_id, tokens=seq.prompt_ids + seq.tokens[:-1])
+            obs.flight.record(
+                "finish", seq_id=seq_id, tokens=len(seq.tokens),
+                finish_reason=seq.finish_reason,
+                request_id=obs.flight.request_id_of(seq.trace),
+            )
             if seq.decode_span is not None:
                 # Aborted/errored sequences can reach finish() with the
                 # decode span still open.

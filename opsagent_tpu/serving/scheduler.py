@@ -168,6 +168,10 @@ class Scheduler:
             if now - req.enqueued_s > self.admission_timeout_s:
                 req.error = "admission timed out (engine saturated)"
                 obs.ENGINE_REQUESTS.inc(outcome="timeout")
+                obs.flight.anomaly(
+                    "request_error", error=req.error,
+                    request_id=obs.flight.request_id_of(req.trace),
+                )
                 req.done.set()
                 continue
             try:
@@ -319,6 +323,10 @@ class Scheduler:
         if isinstance(e, (InvalidRequest, PromptTooLong)):
             req.error_status = 400
         obs.ENGINE_REQUESTS.inc(outcome="admission_failed")
+        obs.flight.anomaly(
+            "request_error", seq_id=sid, error=str(e),
+            request_id=obs.flight.request_id_of(req.trace),
+        )
         req.done.set()
 
     def _reap(self) -> None:
@@ -340,6 +348,11 @@ class Scheduler:
             obs.ENGINE_REQUESTS.inc(
                 outcome="error" if req.error else "completed"
             )
+            if req.error:
+                obs.flight.anomaly(
+                    "request_error", seq_id=sid, error=req.error,
+                    request_id=obs.flight.request_id_of(req.trace),
+                )
             req.done.set()
 
     def _recover(self) -> None:
@@ -364,6 +377,11 @@ class Scheduler:
             "%d running + %d prefilling requests",
             self._restarts, self._max_restarts,
             len(self._running), len(self._prefilling),
+        )
+        obs.flight.anomaly(
+            "engine_restart", restart=self._restarts,
+            max_restarts=self._max_restarts,
+            running=len(self._running), prefilling=len(self._prefilling),
         )
         salvaged: list[Request] = []
         for sid, req in list(self._running.items()):
@@ -495,6 +513,11 @@ class Scheduler:
                     consecutive_failures = 0
                     continue
                 log.error("engine failing persistently; failing in-flight requests")
+                obs.flight.anomaly(
+                    "request_error",
+                    error=f"engine failing persistently: {e}",
+                    failed_requests=len(self._running),
+                )
                 for sid, req in list(self._running.items()):
                     req.error = f"engine step failed: {e}"
                     # Earlier restarts' salvage was already streamed to the

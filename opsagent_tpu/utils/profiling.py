@@ -101,6 +101,46 @@ def device_timer(name: str, outputs: list[Any]) -> Iterator[None]:
     )
 
 
+MAX_CAPTURE_SECONDS = 120.0
+
+
+def timed_capture(seconds: float, logdir: str | None = None) -> str:
+    """Capture a ``jax.profiler`` device trace of the NEXT ``seconds`` of
+    whatever the process is doing — the on-demand form behind
+    ``POST /api/debug/profile?seconds=N``: live traffic keeps flowing
+    while the capture runs, so the trace shows the real serving mix
+    (dispatch composition, compiles, host gaps) instead of a synthetic
+    bench loop. Blocking: run from a worker thread, never the event loop.
+
+    Raises ``ValueError`` for a silly duration, ``RuntimeError`` when no
+    trace directory is configured (``--profile-dir`` /
+    ``$OPSAGENT_PROFILE_DIR`` — operator-configured only, so a network
+    client cannot mint an arbitrary-filesystem-write primitive), and
+    whatever ``jax.profiler.start_trace`` raises when a capture is
+    already running (the caller maps that to 409)."""
+    if not 0 < seconds <= MAX_CAPTURE_SECONDS:
+        raise ValueError(
+            f"seconds must be in (0, {MAX_CAPTURE_SECONDS:.0f}], "
+            f"got {seconds}"
+        )
+    logdir = logdir or profile_dir()
+    if not logdir:
+        raise RuntimeError(
+            "profiling not enabled: start the server with --profile-dir "
+            "(or set OPSAGENT_PROFILE_DIR)"
+        )
+    import time
+
+    jax.profiler.start_trace(logdir)
+    log.info(f"on-demand profile capture started ({seconds}s) -> {logdir}")
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+        log.info(f"on-demand profile capture written -> {logdir}")
+    return logdir
+
+
 def save_device_memory_profile(path: str) -> None:
     """Dump the current device memory profile (pprof format) — which
     buffers hold HBM right now. Pairs with the allocator's page

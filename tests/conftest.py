@@ -23,6 +23,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Flight-recorder anomaly dumps (e.g. a slow first compile-laden TTFT
+# crossing the 500 ms threshold) go to a throwaway dir, not the repo's
+# logs/; tests that assert on dumps monkeypatch their own dir.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "OPSAGENT_FLIGHT_DIR", tempfile.mkdtemp(prefix="opsagent-flight-")
+)
+
 import pytest  # noqa: E402
 
 from opsagent_tpu import obs  # noqa: E402
@@ -89,20 +98,28 @@ def fake_tools():
     tools_pkg.copilot_tools.update(saved)
 
 
+def _reset_obs():
+    # Observability isolation: clear the metric SAMPLES (instruments stay
+    # registered), the trace ring, the flight-recorder ring, the SLO
+    # watchdog's rate window, and the compile watchdog's warmed flag —
+    # one test's engine warmup must not turn a later test's lazy compile
+    # into a "post-warmup compile" anomaly dump.
+    obs.get_registry().reset()
+    obs.get_store().clear()
+    obs.flight.get_recorder().reset()
+    obs.flight.reset_compile_watchdog()
+    obs.slo.get_watchdog().reset()
+
+
 @pytest.fixture(autouse=True)
 def clean_state():
     clear_globals()
     get_perf_stats().reset()
-    # Observability isolation: clear the metric SAMPLES (instruments stay
-    # registered) and the trace ring, so count assertions see only their
-    # own test's traffic.
-    obs.get_registry().reset()
-    obs.get_store().clear()
+    _reset_obs()
     yield
     clear_globals()
     get_perf_stats().reset()
-    obs.get_registry().reset()
-    obs.get_store().clear()
+    _reset_obs()
 
 
 # -- fast/slow lanes ---------------------------------------------------------

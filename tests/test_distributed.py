@@ -7,8 +7,10 @@ not just imports.
 
 On TPU pods the same ``jax.distributed.initialize`` call rides the pod
 metadata and the collectives ride ICI/DCN; here each process hosts two
-virtual CPU devices and the collective rides the distributed runtime's
-TCP transport — same code path in this framework, different PJRT wire.
+virtual CPU devices and the collective rides gloo over TCP
+(``jax_cpu_collectives_implementation`` — XLA:CPU's default "none"
+rejects multiprocess computations outright) — same code path in this
+framework, different collective wire.
 """
 
 import os
@@ -22,6 +24,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CHILD = r"""
 import jax
+
+# Cross-process computations on XLA:CPU need a real collectives backend
+# (the default "none" raises "Multiprocess computations aren't
+# implemented on the CPU backend"); gloo rides plain TCP. Must be set
+# before backend init.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P

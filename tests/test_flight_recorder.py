@@ -1,0 +1,313 @@
+"""Flight recorder, compile watchdog, and debug endpoints (ISSUE 3).
+
+Covers the acceptance gates on the tiny CPU engine: (a) the event ring
+stays bounded under concurrent writers; (b) an induced anomaly (TTFT
+threshold breach on a real engine request) auto-dumps a JSONL file whose
+events reconstruct the offending request's dispatch sequence, and
+``GET /api/slo`` + the ``slo-check`` CLI report the breach with the same
+numbers the PR-1 histograms show; (c) a forced post-warmup recompile
+trips the compile watchdog (counter, gauge, anomaly); (d) the
+``/api/debug/flight`` and ``/api/slo`` handlers round-trip on both
+servers, with the agent server's JWT guard intact.
+"""
+
+import asyncio
+import glob
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from opsagent_tpu import obs
+from opsagent_tpu.obs.flight import FlightRecorder
+from opsagent_tpu.serving.api import ServingStack, build_engine_app
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.sampler import SamplingParams
+from opsagent_tpu.serving.scheduler import Scheduler
+
+BASE = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+    num_pages=128, max_pages_per_seq=16, max_batch_size=4,
+    prefill_buckets=(8, 16), decode_block=4,
+)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# -- (a) ring bound -----------------------------------------------------------
+def test_ring_bound_under_concurrent_writers():
+    rec = FlightRecorder(capacity=256, dump_interval_s=1e9)
+    n_threads, per_thread = 8, 500
+
+    def writer(tid):
+        for i in range(per_thread):
+            rec.record("spam", tid=tid, i=i)
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = rec.snapshot()
+    assert len(events) == 256          # bounded, not 4000
+    stats = rec.stats()
+    assert stats["total_recorded"] == n_threads * per_thread
+    assert stats["dropped"] == n_threads * per_thread - 256
+    ids = [e["id"] for e in events]
+    assert ids == sorted(ids)          # newest-last, no interleaving damage
+    # Every event survived intact (no torn writes).
+    assert all(e["kind"] == "spam" and "tid" in e for e in events)
+
+
+def test_snapshot_filters():
+    rec = FlightRecorder(capacity=32, dump_interval_s=1e9)
+    for i in range(5):
+        rec.record("a", i=i)
+        rec.record("b", i=i)
+    assert len(rec.snapshot(kind="a")) == 5
+    assert [e["i"] for e in rec.snapshot(n=3)] == [3, 4, 4]
+    assert [e["i"] for e in rec.snapshot(n=2, kind="b")] == [3, 4]
+
+
+# -- (b) induced anomaly: the acceptance scenario -----------------------------
+def test_ttft_breach_dumps_flight_and_slo_agrees(tmp_path, monkeypatch):
+    """An induced TTFT-threshold breach on a REAL engine request must (1)
+    auto-dump a JSONL whose events reconstruct the request's dispatch
+    sequence (admission -> prefill dispatches -> ttft -> anomaly), and
+    (2) show up in the SLO evaluation with the same numbers the
+    opsagent_ttft_seconds histogram holds."""
+    monkeypatch.setenv("OPSAGENT_FLIGHT_DIR", str(tmp_path))
+    # Any first token is "late" against a 1 microsecond threshold.
+    monkeypatch.setenv("OPSAGENT_SLO_TTFT_MS", "0.001")
+    eng = Engine(EngineConfig(**BASE))
+    sched = Scheduler(eng)
+    sched.start()
+    try:
+        # A multi-chunk prompt so the dump shows several prefill
+        # dispatches for the same sequence.
+        toks = sched.complete(
+            [257] + list(range(1, 20)), SamplingParams(max_tokens=4),
+            timeout_s=120,
+        )
+        assert toks
+    finally:
+        sched.stop()
+
+    dumps = sorted(glob.glob(str(tmp_path / "flight-*.jsonl")))
+    assert dumps, "TTFT breach produced no flight dump"
+    lines = [json.loads(ln) for ln in open(dumps[0])]
+    header, events = lines[0], lines[1:]
+    assert header["kind"] == "dump_header"
+    assert header["reason"] == "ttft_breach"
+
+    ttft_evs = [e for e in events if e["kind"] == "ttft"]
+    assert len(ttft_evs) == 1
+    sid = ttft_evs[0]["seq_id"]
+    # Reconstruction: the admission and every prefill dispatch of the
+    # offending sequence precede its ttft event, in recorded order.
+    adm = [e for e in events if e["kind"] == "admission" and e["seq_id"] == sid]
+    assert len(adm) == 1 and adm[0]["prompt_tokens"] == 20
+    prefills = [
+        e for e in events
+        if e["kind"] == "dispatch" and e.get("op") in (
+            "prefill_chunk", "prefill_batch", "mixed"
+        ) and (
+            e.get("seq_id") == sid
+            or sid in (e.get("seq_ids") or [])
+            or sid in (e.get("prefill_seq_ids") or [])
+        )
+    ]
+    assert prefills, "no prefill dispatch recorded for the breaching seq"
+    # Every prompt token is accounted for across the recorded prefill
+    # dispatches (one mixed chunk, or several split-path chunks).
+    assert sum(e.get("prefill_tokens", 0) for e in prefills) == 20
+    anomaly = [e for e in events if e["kind"] == "anomaly"][-1]
+    assert anomaly["reason"] == "ttft_breach" and anomaly["seq_id"] == sid
+    assert adm[0]["id"] < prefills[0]["id"] < ttft_evs[0]["id"] < anomaly["id"]
+    # The dumped ttft matches what the histogram observed (one sample,
+    # so the sum IS the sample).
+    assert obs.TTFT_SECONDS.count() == 1
+    assert ttft_evs[0]["ttft_ms"] == pytest.approx(
+        obs.TTFT_SECONDS.sum() * 1e3, rel=1e-3
+    )
+
+    # (2) the SLO watchdog reports the breach from the same histogram.
+    from opsagent_tpu.obs.slo import histogram_quantile
+
+    res = obs.slo.evaluate()
+    ttft = next(v for v in res["slos"] if v["name"] == "ttft_p50_ms")
+    assert ttft["pass"] is False
+    assert ttft["count"] == obs.TTFT_SECONDS.count()
+    assert ttft["sum"] == pytest.approx(obs.TTFT_SECONDS.sum(), rel=1e-6)
+    assert ttft["value"] == pytest.approx(
+        histogram_quantile(obs.TTFT_SECONDS, 0.5) * 1e3, rel=1e-6
+    )
+    assert ttft["burn_rate"] > 1.0
+    assert res["pass"] is False
+
+    # ...and the slo-check CLI (in-process source) exits 1 on the breach.
+    from opsagent_tpu.cli.main import main as cli_main
+
+    assert cli_main(["slo-check"]) == 1
+
+
+# -- (c) compile watchdog -----------------------------------------------------
+def test_forced_post_warmup_recompile_counts_and_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv("OPSAGENT_FLIGHT_DIR", str(tmp_path))
+    n_serving0 = obs.COMPILES.value(phase="serving")
+    gauge0 = obs.POST_WARMUP_COMPILES.value()
+    with obs.flight.warmup_phase():
+        jax.jit(lambda x: x * 2 + 11)(jnp.arange(13))
+    n_warm = obs.COMPILES.value(phase="warmup")
+    assert n_warm >= 1
+    assert obs.flight.warmed()
+    # Forced recompile AFTER warmup: a fresh program shape.
+    jax.jit(lambda x: x * 3 + 17)(jnp.arange(29))
+    n_serving = obs.COMPILES.value(phase="serving")
+    assert n_serving > n_serving0
+    assert obs.POST_WARMUP_COMPILES.value() > gauge0
+    # The anomaly dumped the ring, and the ring holds the compile event.
+    dumps = glob.glob(str(tmp_path / "flight-*post_warmup_compile*.jsonl"))
+    assert dumps
+    compiles = obs.flight.get_recorder().snapshot(kind="compile")
+    assert any(e["phase"] == "serving" for e in compiles)
+    assert any(e["phase"] == "warmup" for e in compiles)
+    # The live /metrics gauge form of the zero-post-warmup invariant.
+    text = obs.metrics_text()
+    assert "opsagent_post_warmup_compiles" in text
+
+
+def test_compiles_before_any_warmup_are_not_anomalies():
+    anomalies0 = len(obs.flight.get_recorder().snapshot(kind="anomaly"))
+    jax.jit(lambda x: x + 41)(jnp.arange(5))
+    assert obs.COMPILES.value(phase="startup") >= 1
+    assert len(
+        obs.flight.get_recorder().snapshot(kind="anomaly")
+    ) == anomalies0
+
+
+# -- (d) endpoint round-trips -------------------------------------------------
+class _FakeEngine:
+    """The endpoints under test never touch the engine; a bare stack
+    carrier keeps this test free of a device-engine build."""
+
+    def __init__(self):
+        self.cfg = EngineConfig(model="tiny-test")
+
+
+def _fake_stack():
+    s = ServingStack.__new__(ServingStack)
+    s.engine = _FakeEngine()
+    s.model_name = "tiny-test"
+    return s
+
+
+def test_engine_app_flight_and_slo_roundtrip():
+    obs.flight.record("dispatch", op="decode_block", seq_ids=[7])
+    obs.flight.record("admission", seq_id=7, prompt_tokens=3,
+                      prefix_hit_tokens=0, request_id=None)
+    obs.TTFT_SECONDS.observe(0.05)
+    app = build_engine_app(_fake_stack())
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/api/debug/flight")
+            assert r.status == 200
+            body = await r.json()
+            assert body["events"] and body["capacity"] > 0
+            kinds = [e["kind"] for e in body["events"]]
+            assert "dispatch" in kinds and "admission" in kinds
+
+            r = await client.get("/api/debug/flight?kind=admission&n=1")
+            body = await r.json()
+            assert [e["kind"] for e in body["events"]] == ["admission"]
+
+            r = await client.get("/api/debug/flight?n=bogus")
+            assert r.status == 400
+
+            r = await client.get("/api/slo")
+            assert r.status == 200
+            slo = await r.json()
+            names = {v["name"] for v in slo["slos"]}
+            assert {"ttft_p50_ms", "itl_p50_ms", "error_rate"} <= names
+            ttft = next(
+                v for v in slo["slos"] if v["name"] == "ttft_p50_ms"
+            )
+            assert ttft["pass"] is True and ttft["count"] == 1
+
+            # Profile capture: not configured -> 403; bad seconds -> 400.
+            r = await client.post("/api/debug/profile?seconds=1")
+            assert r.status == 403
+            r = await client.post("/api/debug/profile?seconds=0")
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    run(scenario())
+
+
+def test_engine_app_profile_capture_works(tmp_path, monkeypatch):
+    monkeypatch.setenv("OPSAGENT_PROFILE_DIR", str(tmp_path))
+    app = build_engine_app(_fake_stack())
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/api/debug/profile?seconds=0.05")
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["status"] == "captured"
+            assert body["logdir"] == str(tmp_path)
+        finally:
+            await client.close()
+
+    run(scenario())
+    # jax wrote an actual trace capture under the logdir.
+    assert glob.glob(str(tmp_path / "**" / "*"), recursive=True)
+
+
+def test_agent_server_slo_public_flight_jwt_guarded():
+    from opsagent_tpu.server.app import build_app
+    from opsagent_tpu.server.jwtauth import issue_token
+    from opsagent_tpu.utils.globalstore import set_global
+
+    set_global("jwtKey", "test-key")
+    obs.flight.record("tool_exec", tool="kubectl", outcome="ok",
+                      duration_ms=1.0)
+    app = build_app()
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/api/slo")
+            assert r.status == 200           # public, like /metrics
+            assert "slos" in await r.json()
+
+            r = await client.get("/api/debug/flight")
+            assert r.status == 401           # JWT-guarded
+
+            token = issue_token("admin", "test-key")
+            r = await client.get(
+                "/api/debug/flight",
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert any(
+                e["kind"] == "tool_exec" for e in body["events"]
+            )
+        finally:
+            await client.close()
+
+    run(scenario())

@@ -1,0 +1,188 @@
+"""Metrics-exposition conformance (fast lane): parse the ``/metrics``
+document against the Prometheus text-format grammar.
+
+The registry is hand-rolled (no prometheus_client in the container), so
+nothing but this test stands between a formatting bug and a scrape that
+silently drops samples. Checks, per the exposition format spec
+(``text/plain; version=0.0.4``):
+
+- every line is a valid comment/HELP/TYPE/sample line;
+- metric and label names match the allowed charsets; label values are
+  properly escaped (no raw newline/quote inside the quotes);
+- at most one TYPE per metric family, declared before its samples, and
+  each family's samples form one contiguous group;
+- histogram families carry ``_bucket``/``_sum``/``_count`` series with
+  cumulative non-decreasing ``le`` buckets ending at ``+Inf`` == count;
+- the document ends with a newline.
+
+Traffic includes label values that exercise the escaper (quotes,
+backslashes, newlines) and every instrument family (counter, gauge,
+histogram, the PerfStats bridge, the SLO collector).
+"""
+
+import math
+import re
+
+from opsagent_tpu import obs
+from opsagent_tpu.utils.perf import get_perf_stats
+
+METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+LABEL_NAME = r"[a-zA-Z_][a-zA-Z0-9_]*"
+# Escaped label value: backslash, double quote, and newline must appear
+# only in their escaped forms.
+LABEL_VALUE = r'"(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+LABELS = rf"\{{{LABEL_NAME}={LABEL_VALUE}(?:,{LABEL_NAME}={LABEL_VALUE})*,?\}}"
+VALUE = r"(?:[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|Inf)|NaN)"
+SAMPLE_RE = re.compile(
+    rf"^({METRIC_NAME})({LABELS})? ({VALUE})(?: [+-]?\d+)?$"
+)
+HELP_RE = re.compile(rf"^# HELP ({METRIC_NAME}) .*$")
+TYPE_RE = re.compile(
+    rf"^# TYPE ({METRIC_NAME}) (counter|gauge|histogram|summary|untyped)$"
+)
+HISTO_SUFFIX = re.compile(r"_(bucket|sum|count)$")
+
+
+def _family(sample_name: str, types: dict[str, str]) -> str:
+    """The metric family a sample belongs to: histogram samples use the
+    suffixed names of their declared family."""
+    m = HISTO_SUFFIX.search(sample_name)
+    if m:
+        base = sample_name[: m.start()]
+        if types.get(base) == "histogram":
+            return base
+    return sample_name
+
+
+def _generate_traffic():
+    obs.TTFT_SECONDS.observe(0.012)
+    obs.TTFT_SECONDS.observe(0.7)
+    obs.TTFT_SECONDS.observe(3.0)
+    obs.ITL_SECONDS.observe(0.004)
+    obs.DECODE_TOKENS.inc(42)
+    obs.ENGINE_REQUESTS.inc(outcome="completed")
+    obs.ENGINE_REQUESTS.inc(outcome="error")
+    # Label values that must round-trip through the escaper.
+    obs.HTTP_REQUESTS.inc(
+        method="GET", path='/weird"path\\with\nnewline', status="200"
+    )
+    obs.TOOL_CALLS.inc(tool="kubectl", outcome="ok")
+    obs.KV_PAGE_UTILIZATION.set(0.375)
+    obs.COMPILES.inc(phase="startup")
+    # PerfStats bridge lines.
+    get_perf_stats().record_metric("engine.ttft", 12.5, "ms")
+    get_perf_stats().record_metric('series"quote', 1.0, "ms")
+
+
+def test_metrics_exposition_conforms():
+    _generate_traffic()
+    text = obs.metrics_text()
+    assert text.endswith("\n"), "document must end with a newline"
+    lines = text.split("\n")[:-1]
+    assert lines, "empty exposition"
+
+    types: dict[str, str] = {}
+    sample_values: dict[tuple, float] = {}
+    family_order: list[str] = []   # first-seen order of sample families
+
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            assert HELP_RE.match(ln), f"bad HELP line: {ln!r}"
+            continue
+        if ln.startswith("# TYPE "):
+            m = TYPE_RE.match(ln)
+            assert m, f"bad TYPE line: {ln!r}"
+            name, kind = m.group(1), m.group(2)
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        if ln.startswith("#"):
+            continue  # plain comment
+        m = SAMPLE_RE.match(ln)
+        assert m, f"bad sample line: {ln!r}"
+        name = m.group(1)
+        fam = _family(name, types)
+        family_order.append(fam)
+        key = (name, m.group(2) or "")
+        assert key not in sample_values, f"duplicate sample: {ln!r}"
+        sample_values[key] = float(m.group(3).replace("Inf", "inf"))
+
+    # Contiguity: samples of one family must form one group.
+    seen_done: set[str] = set()
+    prev = None
+    for fam in family_order:
+        if fam != prev:
+            assert fam not in seen_done, (
+                f"family {fam} interleaved with other families"
+            )
+            if prev is not None:
+                seen_done.add(prev)
+            prev = fam
+
+    # Histogram semantics.
+    for fam, kind in types.items():
+        if kind != "histogram":
+            continue
+        buckets = [
+            (k, v) for k, v in sample_values.items()
+            if k[0] == f"{fam}_bucket"
+        ]
+        if not buckets:
+            continue  # registered but never observed: no samples at all
+        # Group buckets by their non-le labels (histogram children).
+        by_child: dict[str, list[tuple[float, float]]] = {}
+        for (name, labels), v in buckets:
+            le = re.search(rf'le="({VALUE})"', labels)
+            assert le, f"bucket without le label: {name}{labels}"
+            rest = re.sub(rf',?le="{re.escape(le.group(1))}"', "", labels)
+            if rest == "{}":
+                rest = ""  # le was the only label
+            by_child.setdefault(rest, []).append(
+                (float(le.group(1).replace("Inf", "inf")), v)
+            )
+        for child, series in by_child.items():
+            series.sort(key=lambda t: t[0])
+            les = [le for le, _ in series]
+            counts = [c for _, c in series]
+            assert les[-1] == math.inf, f"{fam}{child}: no +Inf bucket"
+            assert counts == sorted(counts), (
+                f"{fam}{child}: buckets not cumulative: {counts}"
+            )
+            # +Inf bucket equals the child's _count sample, and _sum
+            # exists for it.
+            assert sample_values[(f"{fam}_count", child)] == counts[-1], (
+                f"{fam}{child}: +Inf bucket != _count"
+            )
+            assert (f"{fam}_sum", child) in sample_values
+
+
+def test_escaped_label_values_roundtrip():
+    """The escaper's output must re-parse to the original value."""
+    from opsagent_tpu.obs.metrics import escape_label_value
+
+    for raw in ['plain', 'with"quote', "back\\slash", "new\nline",
+                'all\\"\nthree']:
+        esc = escape_label_value(raw)
+        assert "\n" not in esc
+        unescaped = (
+            esc.replace("\\\\", "\x00")
+            .replace('\\"', '"')
+            .replace("\\n", "\n")
+            .replace("\x00", "\\")
+        )
+        assert unescaped == raw
+
+
+def test_engine_servers_expose_same_document_shape():
+    """Both servers' /metrics handlers serve the identical registry
+    render (one process-wide registry — co-hosted deployments scrape
+    either port)."""
+    _generate_traffic()
+    a = obs.metrics_text()
+    b = obs.metrics_text()
+    # Modulo the SLO collector's evaluated_at drift, consecutive renders
+    # of an idle registry agree line-for-line.
+    strip = lambda t: [  # noqa: E731
+        ln for ln in t.splitlines() if not ln.startswith("opsagent_slo_")
+    ]
+    assert strip(a) == strip(b)

@@ -1,0 +1,149 @@
+"""SLO watchdog unit coverage: quantile estimation, verdict arithmetic,
+burn rates, throughput rate windows, the scrape collector, bench.py's
+extra.slo folding, and the slo-check CLI's three exit codes."""
+
+import json
+
+import pytest
+
+from opsagent_tpu import obs
+from opsagent_tpu.cli.main import main as cli_main
+from opsagent_tpu.obs.slo import (
+    SLOWatchdog,
+    declared_slos,
+    histogram_quantile,
+)
+
+
+def test_histogram_quantile_interpolates():
+    h = obs.get_registry().histogram(
+        "test_slo_quantile_seconds", "t", buckets=(0.1, 0.2, 0.4, 0.8)
+    )
+    for v in (0.05, 0.15, 0.15, 0.3):
+        h.observe(v)
+    # rank 2 of 4 lands in the (0.1, 0.2] bucket (2 samples, cum 1
+    # before): 0.1 + 0.1 * (2 - 1) / 2 = 0.15.
+    assert histogram_quantile(h, 0.5) == pytest.approx(0.15)
+    # p100 rank 4 -> (0.2, 0.4] bucket upper region.
+    assert histogram_quantile(h, 1.0) == pytest.approx(0.4)
+    # Overflow clamp: everything past the last finite bound.
+    h2 = obs.get_registry().histogram(
+        "test_slo_overflow_seconds", "t", buckets=(0.1,)
+    )
+    h2.observe(5.0)
+    assert histogram_quantile(h2, 0.5) == 0.1
+    # Empty histogram -> no estimate.
+    h3 = obs.get_registry().histogram(
+        "test_slo_empty_seconds", "t", buckets=(1.0,)
+    )
+    assert histogram_quantile(h3, 0.5) is None
+
+
+def test_declared_targets_env_tunable(monkeypatch):
+    monkeypatch.setenv("OPSAGENT_SLO_TTFT_MS", "250")
+    monkeypatch.setenv("OPSAGENT_SLO_TOK_S_CHIP", "2000")
+    slos = {s.name: s for s in declared_slos()}
+    assert slos["ttft_p50_ms"].target == 250.0
+    assert slos["decode_tok_s_chip"].target == 2000.0
+    assert slos["decode_tok_s_chip"].direction == "gt"
+    monkeypatch.delenv("OPSAGENT_SLO_TOK_S_CHIP")
+    assert "decode_tok_s_chip" not in {s.name for s in declared_slos()}
+
+
+def test_evaluate_no_data_is_not_a_pass():
+    res = obs.slo.evaluate()
+    for v in res["slos"]:
+        assert v["pass"] is None and v["value"] is None
+    assert res["pass"] is True  # nothing FAILED (but nothing passed)
+    assert cli_main(["slo-check"]) == 2  # the CLI calls that "no data"
+
+
+def test_evaluate_pass_and_fail_directions(monkeypatch):
+    monkeypatch.setenv("OPSAGENT_SLO_TTFT_MS", "500")
+    for _ in range(10):
+        obs.TTFT_SECONDS.observe(0.05)
+    obs.ITL_SECONDS.observe(0.3)   # p50 300 ms-ish > 100 ms target
+    obs.ENGINE_REQUESTS.inc(outcome="completed", amount=99)
+    res = obs.slo.evaluate()
+    by = {v["name"]: v for v in res["slos"]}
+    assert by["ttft_p50_ms"]["pass"] is True
+    assert by["ttft_p50_ms"]["burn_rate"] < 1.0
+    assert by["itl_p50_ms"]["pass"] is False
+    assert by["itl_p50_ms"]["burn_rate"] > 1.0
+    assert "breached_for_s" in by["itl_p50_ms"]
+    assert by["error_rate"]["pass"] is True
+    assert res["pass"] is False
+    # The breach transition landed in the flight ring.
+    breaches = obs.flight.get_recorder().snapshot(kind="slo_breach")
+    assert any(e["slo"] == "itl_p50_ms" for e in breaches)
+    assert cli_main(["slo-check"]) == 1
+
+
+def test_throughput_rate_window(monkeypatch):
+    # Low target: the 8-device CPU mesh divides the rate by 8 chips.
+    monkeypatch.setenv("OPSAGENT_SLO_TOK_S_CHIP", "1")
+    w = SLOWatchdog()
+    res = w.evaluate()
+    tok = next(v for v in res["slos"] if v["name"] == "decode_tok_s_chip")
+    assert tok["pass"] is None  # no window yet
+    # Fake a 2-second-old snapshot with 100 fewer tokens: 50 tok/s.
+    obs.DECODE_TOKENS.inc(100)
+    with w._lock:
+        w._snaps = [(w._snaps[-1][0] - 2.0, obs.DECODE_TOKENS.value() - 100)]
+    res = w.evaluate()
+    tok = next(v for v in res["slos"] if v["name"] == "decode_tok_s_chip")
+    assert tok["value"] == pytest.approx(50.0 / tok["chips"], rel=0.2)
+    assert tok["pass"] is True
+
+
+def test_scrape_collector_gauges():
+    obs.TTFT_SECONDS.observe(2.0)  # breach at the 500 ms default
+    text = obs.metrics_text()
+    assert 'opsagent_slo_pass{slo="ttft_p50_ms"} 0' in text
+    assert 'opsagent_slo_burn_rate{slo="ttft_p50_ms"}' in text
+    assert 'opsagent_slo_value{slo="ttft_p50_ms"}' in text
+    # No data for ITL in this test: -1, not a fake verdict.
+    assert 'opsagent_slo_pass{slo="itl_p50_ms"} -1' in text
+
+
+def test_slo_check_bench_file(tmp_path):
+    ok_line = {
+        "metric": "m", "value": 1.0,
+        "extra": {"slo": {"slos": [
+            {"name": "ttft_p50_ms", "target": 500, "value": 80,
+             "burn_rate": 0.16, "pass": True, "unit": "ms"},
+        ], "pass": True}},
+    }
+    bad_line = json.loads(json.dumps(ok_line))
+    bad_line["extra"]["slo"]["slos"][0].update(
+        value=800, burn_rate=1.6, **{"pass": False}
+    )
+    p_ok = tmp_path / "ok.jsonl"
+    p_ok.write_text(json.dumps(ok_line) + "\n")
+    p_bad = tmp_path / "bad.jsonl"
+    # Last extra.slo wins (the orchestrator's combined line is printed
+    # last).
+    p_bad.write_text(json.dumps(ok_line) + "\n" + json.dumps(bad_line) + "\n")
+    p_none = tmp_path / "none.jsonl"
+    p_none.write_text('{"metric": "m", "value": 1.0}\n')
+    assert cli_main(["slo-check", "--bench", str(p_ok)]) == 0
+    assert cli_main(["slo-check", "--bench", str(p_bad)]) == 1
+    assert cli_main(["slo-check", "--bench", str(p_none)]) == 2
+    assert cli_main(["slo-check", "--bench", str(tmp_path / "gone")]) == 2
+
+
+def test_bench_slo_helpers(monkeypatch):
+    import bench
+
+    obs.TTFT_SECONDS.observe(0.05)
+    v = bench.slo_verdicts()
+    assert {s["name"] for s in v["slos"]} >= {"ttft_p50_ms"}
+    # Strict gate: breached SLO exits 3 AFTER the result line.
+    monkeypatch.setenv("OPSAGENT_BENCH_SLO_STRICT", "1")
+    obs.TTFT_SECONDS.observe(5.0)
+    obs.TTFT_SECONDS.observe(5.0)
+    with pytest.raises(SystemExit) as ei:
+        bench.exit_if_slo_breach(bench.slo_verdicts())
+    assert ei.value.code == 3
+    monkeypatch.setenv("OPSAGENT_BENCH_SLO_STRICT", "0")
+    bench.exit_if_slo_breach(bench.slo_verdicts())  # gate off: no exit
