@@ -8,18 +8,19 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to twelve stages in isolated
+A plain `python bench.py` orchestrates up to thirteen stages in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
 the guaranteed number), then the bench-8b int8 headline, its int4,
 int8-KV-pages, and combined int4+int8-KV variants (the fastest 8B
 variant becomes the headline), the BASELINE config-5 concurrent-sessions
 run, the sessions-mixed A/B (mixed prefill+decode batching on vs. off on
-the same workload), the agent-turns stage (north-star p50 TTFT per
-tool-call turn), the pallas-dma kernel comparison (plain and kv-int8), a
-cold-restart TTFT probe against the stage-1-primed compilation cache,
-and last a speculative-decoding overhead run (its question is already
-measurement-closed).
+the same workload), the sessions-offload A/B (hierarchical KV: host-RAM
+offload tier off vs. on under page pressure), the agent-turns stage
+(north-star p50 TTFT per tool-call turn), the pallas-dma kernel
+comparison (plain and kv-int8), a cold-restart TTFT probe against the
+stage-1-primed compilation cache, and last a speculative-decoding
+overhead run (its question is already measurement-closed).
 EVERY result line is printed
 and flushed the moment it exists (the driver kills this process at an
 unknown wall clock; an already-earned number must survive), and a
@@ -351,6 +352,13 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         240, "sessions-mixed",
     ) if on_tpu else None
+    # Hierarchical-KV A/B on the same workload under page pressure:
+    # offload tier off vs on (host-pool spill/park/restore) in one child.
+    rsessoff = stage(
+        {"OPSAGENT_BENCH_MODE": "sessions-offload",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        240, "sessions-offload",
+    ) if on_tpu else None
     # The literal north-star metric (BASELINE: p50 TTFT per tool-call
     # turn): multi-turn ReAct-shaped sessions with the prefix cache on.
     # Reports ms, not tok/s — never a headline candidate; folded into
@@ -432,6 +440,18 @@ def run_orchestrated() -> None:
         extra["sessions_mixed_p50_ttft_ms"] = me.get("p50_ttft_ms")
         extra["sessions_split_tok_s_chip"] = me.get("split_tok_s_chip")
         extra["sessions_split_p50_ttft_ms"] = me.get("split_p50_ttft_ms")
+    if rsessoff is not None:
+        oe = rsessoff.get("extra", {})
+        extra["sessions_offload_tok_s_chip"] = rsessoff["value"]
+        extra["sessions_offload_admission_wait_p50_ms"] = oe.get(
+            "admission_wait_p50_ms"
+        )
+        extra["sessions_offload_off_admission_wait_p50_ms"] = oe.get(
+            "off_admission_wait_p50_ms"
+        )
+        extra["sessions_offload_reprefill_avoided_tokens"] = oe.get(
+            "reprefill_avoided_tokens"
+        )
     if ragent is not None:
         ae = ragent.get("extra", {})
         extra["agent_turn_p50_ttft_ms"] = ragent["value"]
@@ -492,7 +512,7 @@ def run_single() -> None:
     # (decode_block x (pipeline_depth + 1) tokens are pre-booked).
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
-    if mode in ("sessions", "agent", "sessions-mixed"):
+    if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -537,11 +557,18 @@ def run_single() -> None:
     max_pages = int(
         os.environ.get("OPSAGENT_BENCH_MAXPAGES", str(default_maxpages))
     )
+    num_pages = max(512 * 64 // page_size, batch * max_pages)
+    if mode == "sessions-offload":
+        # The offload A/B only measures anything under HBM PRESSURE: size
+        # the page pool so the sessions' grown histories cannot all stay
+        # trie-resident — the off phase re-prefills evicted content, the
+        # on phase restores it from the host pool.
+        num_pages = max(int(batch * max_pages * 0.6), max_pages * 2)
     cfg = EngineConfig(
         model=model,
         dtype=dtype,
         max_batch_size=batch,
-        num_pages=max(512 * 64 // page_size, batch * max_pages),
+        num_pages=num_pages,
         page_size=page_size,
         max_pages_per_seq=max_pages,
         prefill_buckets=(prompt_len,),
@@ -550,6 +577,7 @@ def run_single() -> None:
         speculative_k=spec_k,
         decode_block=decode_block,
         mixed_batching=mixed_on,
+        offload=(mode == "sessions-offload"),
     )
     # Fail fast on undersized sweep points: OutOfPages mid-window would
     # force-finish sequences ('length') and quietly deflate the metric.
@@ -581,7 +609,7 @@ def run_single() -> None:
     # full-stack path as sessions (scheduler admission -> chunked prefill
     # -> pipelined decode), so it shares that warmup level.
     t0 = time.perf_counter()
-    if mode in ("sessions", "agent", "sessions-mixed"):
+    if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -598,6 +626,10 @@ def run_single() -> None:
     if mode == "sessions-mixed":
         run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
                            n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "sessions-offload":
+        run_sessions_offload(eng, model, batch, steps, prompt_len, platform,
+                             n_chips, quantize, init_s, warmup_s)
         return
     if mode == "agent":
         # turns/gen_tokens are THE values the page-budget guard above was
@@ -788,12 +820,15 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
 
 
 def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
-                              seed_base: int) -> dict:
+                              seed_base: int, park: bool = False) -> dict:
     """Run ``batch`` concurrent multi-round chat sessions with STREAMING
     completions, measuring client-observed TTFT per round (first yielded
     chunk, error-checked). Returns {produced, wall, ttfts, errors} —
     self-contained client-side measurement, so two phases in one process
-    cannot contaminate each other through global perf-stat snapshots."""
+    cannot contaminate each other through global perf-stat snapshots.
+    ``park=True`` parks each session's KV to the host tier between rounds
+    (ServingStack.park — the tool-execution window of a real agent
+    turn)."""
     import threading
 
     results: list[dict] = []
@@ -808,6 +843,11 @@ def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
             {"role": "user", "content": " ".join(words)},
         ]
         for r in range(rounds):
+            if park and r:
+                # The inter-round gap is where a real agent blocks on its
+                # tool subprocess: hand the HBM back for other sessions'
+                # admissions; this round's admission restores the chain.
+                stack.park(messages)
             t0 = time.perf_counter()
             try:
                 gen = stack.chat_completion_stream({
@@ -913,6 +953,105 @@ def run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
                 mixed["tok_s_chip"] - split["tok_s_chip"], 1
             ),
             "errors": len(mixed["errors"]) + len(split["errors"]),
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
+            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": metrics_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    log_perf_table()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_sessions_offload(eng, model, batch, steps, prompt_len, platform,
+                         n_chips, quantize, init_s, warmup_s) -> None:
+    """The hierarchical-KV A/B stage: the concurrent-sessions workload
+    under HBM page pressure (num_pages was sized below the sessions'
+    aggregate history) run TWICE against the same engine — offload tier
+    OFF (evictions drop content, every comeback re-prefills), then ON
+    (evictions spill to the host pool, sessions park between rounds like
+    a tool-blocked agent turn, comebacks restore with a page copy). Both
+    phases land in ONE JSON line: admission-wait p50 and
+    re-prefill-avoided token counts are the decision numbers the offload
+    tier exists for."""
+    from opsagent_tpu.serving.api import ServingStack
+
+    gen_tokens = max(16, steps // 8)
+    rounds = 3
+    mgr = eng.offload
+    assert mgr is not None, "sessions-offload needs EngineConfig.offload"
+
+    def _avoided() -> float:
+        snap = metrics_snapshot()
+        return float(
+            snap.get("opsagent_offload_reprefill_avoided_tokens_total", 0.0)
+        )
+
+    phases: dict[str, dict] = {}
+    # OFF first: the ON phase's host pool then holds only its own spills.
+    for tag, flag, seed in (("off", False, 3000), ("on", True, 7000)):
+        if flag:
+            eng.offload = mgr
+            eng.alloc.set_spill(eng._spill_page)
+        else:
+            eng.offload = None
+            eng.alloc.set_spill(None)
+        get_perf_stats().reset()
+        avoided0 = _avoided()
+        stack = ServingStack(eng)
+        try:
+            phases[tag] = _drive_sessions_streaming(
+                stack, batch, rounds, gen_tokens, prompt_len, seed,
+                park=flag,
+            )
+        finally:
+            stack.close()
+        r = phases[tag]
+        r["p50_ttft_ms"] = (
+            float(np.median(r["ttfts"]) * 1e3) if r["ttfts"] else 0.0
+        )
+        qw = get_perf_stats().get_stats().get("scheduler.queue_wait", {})
+        r["admission_wait_p50_ms"] = float(qw.get("p50", 0.0))
+        r["reprefill_avoided_tokens"] = int(_avoided() - avoided0)
+        r["tok_s_chip"] = r["produced"] / max(1e-9, r["wall"]) / n_chips
+        log(f"bench[sessions-offload/{tag}]: {batch} sessions x {rounds} "
+            f"rounds, {r['produced']} tokens in {r['wall']:.2f}s -> "
+            f"{r['tok_s_chip']:.0f} tok/s/chip; p50 TTFT "
+            f"{r['p50_ttft_ms']:.0f} ms; admission-wait p50 "
+            f"{r['admission_wait_p50_ms']:.1f} ms; re-prefill avoided "
+            f"{r['reprefill_avoided_tokens']} tok; "
+            f"errors={len(r['errors'])}")
+    on, off = phases["on"], phases["off"]
+    pool = mgr.stats()
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": f"sessions_offload[{model}{qtag},N={batch},{platform}]",
+        "value": round(on["tok_s_chip"], 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": vs_baseline(on["tok_s_chip"], model, platform),
+        "extra": {
+            "sessions": batch,
+            "rounds": rounds,
+            "p50_ttft_ms": round(on["p50_ttft_ms"], 1),
+            "admission_wait_p50_ms": round(on["admission_wait_p50_ms"], 2),
+            "reprefill_avoided_tokens": on["reprefill_avoided_tokens"],
+            "off_tok_s_chip": round(off["tok_s_chip"], 1),
+            "off_p50_ttft_ms": round(off["p50_ttft_ms"], 1),
+            "off_admission_wait_p50_ms": round(
+                off["admission_wait_p50_ms"], 2
+            ),
+            "off_reprefill_avoided_tokens": off["reprefill_avoided_tokens"],
+            "admission_wait_delta_ms": round(
+                off["admission_wait_p50_ms"] - on["admission_wait_p50_ms"], 2
+            ),
+            "host_pool_pages": pool["pages"],
+            "host_pool_bytes": pool["bytes"],
+            "host_pool_drops": pool["drops"],
+            "restored_tokens": pool["restored_tokens"],
+            "errors": len(on["errors"]) + len(off["errors"]),
             "init_s": round(init_s, 1),
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,

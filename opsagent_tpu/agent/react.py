@@ -184,6 +184,20 @@ def _react_loop(
         if name and name in tools:
             if verbose:
                 log.info("tool %s input=%r", name, tool_input[:200])
+            # Tool-time parking (hierarchical KV tier): the subprocess the
+            # tool is about to exec blocks this session for seconds; an
+            # in-tree engine can copy the session's KV pages to host RAM
+            # and free the HBM for queued prompts — the next turn restores
+            # them instead of re-prefilling. No-op for remote providers
+            # and engines without the offload tier.
+            parked_tokens = 0
+            if (model or "").startswith("tpu://"):
+                try:
+                    from ..serving.api import park_session
+
+                    parked_tokens = park_session(model, chat_history)
+                except Exception:  # noqa: BLE001 - parking is best-effort
+                    parked_tokens = 0
             t_tool = time.perf_counter()
 
             def _tool_flight(outcome: str, error: str = "") -> None:
@@ -193,6 +207,8 @@ def _react_loop(
                         (time.perf_counter() - t_tool) * 1e3, 3
                     ),
                 }
+                if parked_tokens:
+                    ev["parked_tokens"] = parked_tokens
                 if error:
                     ev["error"] = error
                 obs.flight.record("tool_exec", **ev)

@@ -133,6 +133,16 @@ def build_parser() -> argparse.ArgumentParser:
              "serving shapes); not supported for MLA models",
     )
     se.add_argument(
+        "--offload",
+        action="store_true",
+        default=False,
+        help="hierarchical KV cache: spill evicted/parked KV pages to a "
+             "bounded host-RAM pool (OPSAGENT_KV_HOST_POOL_BYTES, default "
+             "1 GiB) and restore them on re-admission instead of "
+             "re-prefilling — tool-blocked agent sessions stop pinning "
+             "HBM between turns",
+    )
+    se.add_argument(
         "--platform",
         default="",
         choices=("", "tpu", "cpu"),
@@ -234,6 +244,7 @@ def main(argv: list[str] | None = None) -> int:
             quantize=args.quantize,
             kv_quantize=args.kv_quantize,
             speculative_k=args.speculative_k,
+            offload=args.offload,
         )
         return 0
 

@@ -140,6 +140,54 @@ PREFIX_EVICTIONS = _reg.counter(
     "opsagent_prefix_evictions_total", "Prefix-cache trie leaf evictions"
 )
 
+# -- hierarchical KV cache: host-RAM offload tier -----------------------------
+OFFLOAD_PAGES = _reg.counter(
+    "opsagent_offload_pages_total",
+    "KV pages moved between HBM and the host pool, by direction "
+    "(out = device->host spill, in = host->device restore)",
+    labelnames=("dir",),
+)
+OFFLOAD_BYTES = _reg.counter(
+    "opsagent_offload_bytes_total",
+    "Bytes moved between HBM and the host pool, by direction",
+    labelnames=("dir",),
+)
+OFFLOAD_PARKS = _reg.counter(
+    "opsagent_offload_parks_total",
+    "Session parking events by trigger (tool = ReAct tool-exec window, "
+    "pressure = admission-pressure eviction of a cold session)",
+    labelnames=("trigger",),
+)
+OFFLOAD_RESTORE_SECONDS = _reg.histogram(
+    "opsagent_offload_restore_seconds",
+    "Host->device KV restore latency per admission (copy, not re-prefill)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5),
+)
+OFFLOAD_REPREFILL_AVOIDED = _reg.counter(
+    "opsagent_offload_reprefill_avoided_tokens_total",
+    "Prompt tokens restored from the host pool instead of re-prefilled",
+)
+OFFLOAD_RESTORE_FALLBACKS = _reg.counter(
+    "opsagent_offload_restore_fallbacks_total",
+    "Parked-session admissions that fell back to re-prefill because the "
+    "host pool had dropped their pages (each is a flight-ring anomaly)",
+)
+HOST_POOL_BYTES = _reg.gauge(
+    "opsagent_kv_host_pool_bytes", "Host-RAM KV pool bytes resident"
+)
+HOST_POOL_CAPACITY = _reg.gauge(
+    "opsagent_kv_host_pool_capacity_bytes",
+    "Host-RAM KV pool byte bound (OPSAGENT_KV_HOST_POOL_BYTES)",
+)
+HOST_POOL_PAGES = _reg.gauge(
+    "opsagent_kv_host_pool_pages", "Host-RAM KV pool pages resident"
+)
+HOST_POOL_DROPS = _reg.counter(
+    "opsagent_kv_host_pool_drops_total",
+    "Host-pool pages LRU-dropped under the byte bound",
+)
+
 # -- request lifecycle --------------------------------------------------------
 ENGINE_REQUESTS = _reg.counter(
     "opsagent_engine_requests_total",

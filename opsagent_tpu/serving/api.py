@@ -568,6 +568,29 @@ class ServingStack:
         finish = "stop" if stopped else (req.finish_reason or "length")
         yield chunk({}, finish=finish)
 
+    # -- hierarchical KV tier -----------------------------------------------
+    def park(self, messages: list[dict[str, Any]], tools: Any = None) -> int:
+        """Tool-time parking (hierarchical KV tier): tokenize the session
+        history exactly the way admission would and park its KV chain —
+        copy the trie-resident pages to the host pool, free the HBM. The
+        agent loop calls this when it enters tool execution: for the
+        seconds a ``kubectl``/``trivy``/``python`` subprocess runs, the
+        session's pages would otherwise only deny admission to queued
+        prompts. The next turn's admission restores the chain with a page
+        copy instead of re-prefilling it. Returns tokens parked (0 when
+        the offload tier is off — the call is always safe)."""
+        eng = self.engine
+        if getattr(eng, "offload", None) is None:
+            return 0
+        try:
+            ids = apply_chat_template(
+                eng.tokenizer, messages or [],
+                model_family=self.model_name, tools=tools,
+            )
+        except Exception:  # noqa: BLE001 - parking is best-effort
+            return 0
+        return eng.park_chain(ids)
+
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:
         self.scheduler.stop()
@@ -611,6 +634,30 @@ def installed_stack_max_position(name: str) -> int | None:
     if stack is None:
         return None
     return int(stack.engine.model_cfg.max_position)
+
+
+def park_session(model: str, messages: list[dict[str, Any]],
+                 tools: Any = None) -> int:
+    """Tool-exec signal from the agent loop: park the session's KV to the
+    host tier while the tool subprocess runs (see ServingStack.park).
+    ``model`` may carry the tpu:// scheme. Looks up the ALREADY-installed
+    stack only — never constructs an engine — and never raises: parking
+    is an optimization, the loop must survive its absence."""
+    name = model.split("://", 1)[-1]
+    with _stacks_lock:
+        stack = _stacks.get(name)
+        if stack is None:
+            low = name.lower()
+            stack = next(
+                (s for k, s in _stacks.items() if k.lower() == low), None
+            )
+    if stack is None:
+        return 0
+    try:
+        return stack.park(messages, tools=tools)
+    except Exception:  # noqa: BLE001
+        log.exception("tool-time parking failed (ignored)")
+        return 0
 
 
 def get_stack(name: str) -> ServingStack:
@@ -660,17 +707,18 @@ def build_engine_app(stack: ServingStack):
 
     async def healthz(request: web.Request) -> web.Response:
         eng = stack.engine
-        return web.json_response(
-            {
-                "status": "ok",
-                "model": stack.model_name,
-                "free_pages": eng.alloc.free_pages,
-                "running": len(eng.sequences),
-                "prefix_hit_tokens": eng.alloc.hit_tokens,
-                "prefix_miss_tokens": eng.alloc.miss_tokens,
-                "prefix_evictions": eng.alloc.evictions,
-            }
-        )
+        body = {
+            "status": "ok",
+            "model": stack.model_name,
+            "free_pages": eng.alloc.free_pages,
+            "running": len(eng.sequences),
+            "prefix_hit_tokens": eng.alloc.hit_tokens,
+            "prefix_miss_tokens": eng.alloc.miss_tokens,
+            "prefix_evictions": eng.alloc.evictions,
+        }
+        if getattr(eng, "offload", None) is not None:
+            body["host_pool"] = eng.offload.stats()
+        return web.json_response(body)
 
     async def completions(request: web.Request) -> web.StreamResponse:
         try:
@@ -877,6 +925,7 @@ def run_engine_server(
     quantize: str = "",
     kv_quantize: str = "",
     speculative_k: int = 0,
+    offload: bool = False,
 ) -> None:
     from aiohttp import web
 
@@ -902,6 +951,7 @@ def run_engine_server(
         quantize=quantize,
         kv_quantize=kv_quantize,
         speculative_k=speculative_k,
+        offload=offload,
         # Production server: compile everything before accepting requests
         # so no client ever pays XLA compile inside its TTFT.
         warmup=True,

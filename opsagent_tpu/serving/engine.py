@@ -175,6 +175,19 @@ class EngineConfig:
     max_new_tokens_default: int = 1024
     seed: int = 0
     prefix_cache: bool = True
+    # Hierarchical KV cache: the host-RAM offload tier (serving/offload).
+    # With offload on, trie evictions SPILL page content to a bounded
+    # host pool instead of dropping it, tool-time parking
+    # (park_chain / park_sequence) proactively frees HBM while a session
+    # blocks on tool execution, and admission RESTORES pooled pages with
+    # a device copy instead of re-prefilling them. Requires prefix_cache.
+    offload: bool = False
+    # Pages per copy dispatch (the copy engine's largest bucket); page-id
+    # vectors pad to (1, offload_copy_pages) so the restore path stays
+    # inside the zero-post-warmup-compiles invariant.
+    offload_copy_pages: int = 8
+    # Host pool byte bound; 0 = $OPSAGENT_KV_HOST_POOL_BYTES or 1 GiB.
+    host_pool_bytes: int = 0
     # Weight-only quantization: "" (compute dtype) or "int8" (per-channel
     # symmetric, models.quant). Halves weight HBM traffic — the decode
     # bottleneck — and the footprint: Llama-3-8B fits a 16 GB v5e chip
@@ -382,6 +395,26 @@ class Engine:
             cfg.num_pages, cfg.page_size, cfg.max_pages_per_seq,
             prefix_cache=cfg.prefix_cache,
         )
+        # Host-RAM offload tier: spills ride every trie eviction, restores
+        # ride admission (begin_request). Parking APIs: park_chain (tool
+        # windows), park_sequence (admission-pressure LRU).
+        self.offload = None
+        if cfg.offload and cfg.prefix_cache:
+            from .offload import HostPagePool, OffloadManager, PageCopyEngine
+
+            self.offload = OffloadManager(
+                HostPagePool(
+                    cfg.page_size,
+                    capacity_bytes=cfg.host_pool_bytes or None,
+                ),
+                PageCopyEngine(
+                    mesh_ctx=self.mesh_ctx,
+                    copy_pages=cfg.offload_copy_pages,
+                ),
+                cfg.page_size,
+            )
+            self.alloc.attach_host_pool(self.offload.pool)
+            self.alloc.set_spill(self._spill_page)
         self.sequences: dict[int, Sequence] = {}
         self._evictions_seen = 0  # delta-sync base for the obs counter
         self._sample_key = jax.random.PRNGKey(cfg.seed + 1)
@@ -621,12 +654,12 @@ class Engine:
         ),
         "sessions": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
-            "decode_greedy", "mixed",
+            "decode_greedy", "mixed", "offload",
         }),
         "full": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
             "decode_single", "logprobs", "decode_greedy", "decode_sampled",
-            "fsm", "spec", "mixed",
+            "fsm", "spec", "mixed", "offload",
         }),
     }
 
@@ -810,6 +843,12 @@ class Engine:
                             toks = warm_pipeline(greedy, fm, fd)
                 except Exception:  # noqa: BLE001 - warmup is best-effort
                     log.exception("ToolPrompt FSM warmup failed (non-fatal)")
+            # Offload-tier copy programs (gather + scatter per bucket):
+            # the restore path runs inside admission, where an XLA compile
+            # would be a post-warmup anomaly. warm() rewrites page 0 with
+            # its own content — state-preserving like every warmup call.
+            if "offload" in progs and self.offload is not None:
+                self.cache = self.offload.copier.warm(self.cache)
             if "spec" in progs and self.cfg.speculative_k > 0:
                 H = self.cfg.max_pages_per_seq * self.cfg.page_size
                 ov_hist = jnp.zeros((B, H), jnp.int32)
@@ -885,6 +924,7 @@ class Engine:
         mask_fn: Callable[[list[int]], np.ndarray] | None = None,
         stream: Callable[[int], None] | None = None,
         trace: Any = None,
+        expect_restore: bool = False,
     ) -> int:
         """Stage 1 of admission: allocate pages (reusing any cached prefix)
         and register the sequence in the 'prefilling' state. Cheap — no
@@ -912,19 +952,40 @@ class Engine:
                 sampling, max_tokens=self.model_cfg.max_position - n
             )
         with self.lock:
+            if self.offload is not None:
+                # Land pending spills first: a page parked during the
+                # previous tick must be matchable by THIS admission.
+                self.offload.flush()
             # Prefix cache: reuse full pages of the prompt MINUS its last
             # token (at least one tail token must be prefilled to produce
             # the next-token logits).
             prefix_pages = self.alloc.match_prefix(prompt_ids[: n - 1])
             matched = len(prefix_pages) * self.cfg.page_size
             seq_id = self.alloc.allocate(n, prefix_pages=prefix_pages)
+            restored = self._restore_from_host(
+                seq_id, prompt_ids, n, len(prefix_pages), matched
+            )
+            if expect_restore and matched + restored < (
+                (n - 1) // self.cfg.page_size
+            ) * self.cfg.page_size:
+                # A PARKED session came back and its host-pool pages were
+                # gone (LRU-dropped under the byte bound, or a failed
+                # restore): correctness falls back to re-prefill, but
+                # silently eating that cost is how fidelity regressions
+                # hide — ring-dump it.
+                obs.OFFLOAD_RESTORE_FALLBACKS.inc()
+                obs.flight.anomaly(
+                    "restore_reprefill", seq_id=seq_id, prompt_tokens=n,
+                    prefix_hit_tokens=matched, restored_tokens=restored,
+                    request_id=obs.flight.request_id_of(trace),
+                )
             seq = Sequence(
                 seq_id, n, prompt_ids=list(prompt_ids),
                 params=sampling, mask_fn=mask_fn, stream=stream,
                 trace=trace,
             )
             self.sequences[seq_id] = seq
-            self._prefilling[seq_id] = matched
+            self._prefilling[seq_id] = matched + restored
             if matched:
                 get_perf_stats().record_metric(
                     "engine.prefix_hit_tokens", matched, "tok"
@@ -932,11 +993,56 @@ class Engine:
                 obs.PREFIX_HIT_TOKENS.inc(matched)
             obs.flight.record(
                 "admission", seq_id=seq_id, prompt_tokens=n,
-                prefix_hit_tokens=matched,
+                prefix_hit_tokens=matched, restored_tokens=restored,
                 request_id=obs.flight.request_id_of(trace),
             )
             self._observe_occupancy()
             return seq_id
+
+    def _restore_from_host(
+        self, seq_id: int, prompt_ids: list[int], n: int,
+        shared_pages: int, matched: int,
+    ) -> int:
+        """Restore-instead-of-reprefill: pages of this prompt beyond the
+        HBM trie hit that the host pool still holds are copied back into
+        the freshly-allocated pages and re-registered into the trie
+        (``promote_prefix``), so the prefill loop starts AFTER them and
+        partial restores become trie hits for concurrent admissions.
+        Returns restored token count (0 on miss or any failure — the
+        caller's chunked prefill then covers those tokens, the tier-1
+        behavior)."""
+        if self.offload is None:
+            return 0
+        seq_pages = self.alloc.pages_of(seq_id)
+        entries = self.offload.pool.match(
+            prompt_ids[: n - 1],
+            start_page=shared_pages,
+            max_pages=len(seq_pages) - shared_pages,
+        )
+        if not entries:
+            return 0
+        dst = seq_pages[shared_pages : shared_pages + len(entries)]
+        try:
+            def _keep(c):
+                self.cache = c
+
+            self.cache, restored = self.offload.restore(
+                self.cache, dst, entries, seq_id=seq_id, on_update=_keep
+            )
+        except Exception:  # noqa: BLE001 - fall back to re-prefill
+            log.exception(
+                "host->device KV restore failed; re-prefilling "
+                "(pages will be overwritten by the prefill chunks)"
+            )
+            return 0
+        if restored:
+            self.alloc.promote_prefix(
+                seq_id, prompt_ids[: matched + restored]
+            )
+            get_perf_stats().record_metric(
+                "engine.restore_tokens", restored, "tok"
+            )
+        return restored
 
     def next_prefill_bucket(self, seq_id: int) -> int:
         """Bucket the given admitting sequence's NEXT chunk compiles into —
@@ -1218,11 +1324,22 @@ class Engine:
         DISPATCH cleans up every chunk admission, rolls back the decode
         rows' one-token page bookings, and re-raises."""
         with self.lock:
-            if self._inflight or self._lane_of:
+            while self._inflight or self._lane_of:
                 # Settle the pipelined block-decode state: its device
                 # carry tracks lane write offsets that a mixed dispatch
                 # would silently desync.
-                self._flush_and_invalidate()
+                try:
+                    self._flush_and_invalidate()
+                except Exception:  # noqa: BLE001 - raising stream callback
+                    # A pulled block's raising stream callback belongs to
+                    # its OWN row (already finished as "error"; the reap
+                    # path surfaces it). Propagating from here would fail
+                    # this tick's innocent chunk admissions with another
+                    # client's disconnect — keep draining instead.
+                    log.exception(
+                        "stream callback raised while settling pipelined "
+                        "state for a mixed dispatch; row isolated"
+                    )
             decode = [
                 self.sequences[s] for s in decode_ids
                 if s in self.sequences and not self.sequences[s].done
@@ -2239,6 +2356,105 @@ class Engine:
             while len(self._inflight) > self.cfg.pipeline_depth:
                 _merge_pulls(out, self._pull_oldest())
             return out
+
+    # -- hierarchical KV tier (serving/offload) ------------------------------
+    def _spill_page(self, page: int, chain_tokens: list[int]) -> None:
+        """PageAllocator eviction hook: enqueue a device->host copy of the
+        page being dropped, keyed by its token chain, so the content
+        survives in the host pool. Runs under the engine lock (eviction
+        happens inside allocate/extend); the gather is dispatched here —
+        ordered before any later write to the recycled page — and pulled
+        at the next flush point."""
+        if self.offload is not None:
+            self.offload.spill(self.cache, [(page, chain_tokens)])
+
+    def offload_flush(self) -> int:
+        """Pull pending device->host page copies into the host pool (the
+        double buffer's drain side). Cheap no-op when nothing is pending;
+        the scheduler calls this on idle ticks and admission calls it
+        before matching."""
+        if self.offload is None:
+            return 0
+        with self.lock:
+            return self.offload.flush()
+
+    def park_chain(self, token_ids: list[int]) -> int:
+        """Tool-time parking: free the HBM pages holding this token
+        history's KV (the session's trie-resident state) after copying
+        them to the host pool. Called while the session's ReAct loop
+        blocks on tool execution — the multi-second window where the
+        pages only deny admission to queued prompts. Returns tokens
+        parked (0 when offload is off or nothing was evictable)."""
+        if self.offload is None:
+            return 0
+        with self.lock:
+            pages = self.alloc.match_prefix(token_ids)
+            if not pages:
+                return 0
+            n = self.alloc.evict_chain(pages)
+            if n:
+                obs.OFFLOAD_PARKS.inc(trigger="tool")
+                obs.flight.record(
+                    "park", trigger="tool", pages=n,
+                    tokens=n * self.cfg.page_size,
+                )
+                self._observe_occupancy()
+            return n * self.cfg.page_size
+
+    def park_sequence(self, seq_id: int) -> "Sequence | None":
+        """Pressure parking: offload a LIVE sequence's written pages to
+        the host pool and free ALL its HBM state, returning the tokens it
+        generated so far. The caller (scheduler) re-queues the request
+        with the salvage folded into its prompt — exactly the
+        slice-restart salvage flow — and the re-admission restores the
+        pages from the host pool instead of re-prefilling. Returns the
+        parked Sequence (host-side state: tokens, logprob_data), or None
+        (nothing parked) when the sequence turned out to be finished by
+        the time the pipeline settled — the caller reaps it normally."""
+        if self.offload is None:
+            raise RuntimeError("park_sequence requires the offload tier")
+        with self.lock:
+            # Settle pipelined decode first: in-flight blocks may still
+            # append tokens to this sequence (and their pulls roll page
+            # bookings back to written content). Stream-callback raises
+            # belong to their own (now-errored) rows — keep draining.
+            while self._inflight or self._lane_of:
+                try:
+                    self._flush_and_invalidate()
+                except Exception:  # noqa: BLE001
+                    log.exception(
+                        "stream callback raised while settling pipelined "
+                        "state for parking; row isolated"
+                    )
+            seq = self.sequences.get(seq_id)
+            if seq is None or seq.done:
+                return None  # finished mid-drain: reap, don't park
+            seq = self.sequences.pop(seq_id)
+            written = seq.prompt_ids + seq.tokens[:-1]
+            P = self.cfg.page_size
+            pages = self.alloc.pages_of(seq_id)
+            chains = [
+                (pages[i], written[: (i + 1) * P])
+                for i in range(min(len(written) // P, len(pages)))
+            ]
+            if chains:
+                self.offload.spill(self.cache, chains, trigger="pressure")
+            # Plain free (no trie donation): the content now lives in the
+            # host tier; keeping an HBM copy would defeat the parking.
+            self.alloc.free(seq_id)
+            obs.OFFLOAD_PARKS.inc(trigger="pressure")
+            obs.flight.record(
+                "park", trigger="pressure", seq_id=seq_id,
+                pages=len(chains), tokens=len(chains) * P,
+                generated=len(seq.tokens),
+            )
+            if seq.decode_span is not None:
+                seq.decode_span.close(
+                    tokens=len(seq.tokens), finish_reason="parked"
+                )
+                seq.decode_span = None
+            self._observe_occupancy()
+            return seq
 
     def abort_request(self, seq_id: int) -> None:
         """Abandon a sequence that is still in the prefilling state (e.g.

@@ -83,6 +83,41 @@ class PageAllocator:
         self.hit_tokens = 0   # cumulative prefix-cache hits (stats)
         self.miss_tokens = 0
         self.evictions = 0    # cumulative trie-leaf evictions (stats)
+        # Hierarchical KV tier hooks (serving/offload): ``_spill`` is
+        # called with (page, full token chain) right before a trie node's
+        # content is dropped from HBM, so the host tier can keep it;
+        # ``_host_pool`` is a HostPagePool surfaced through accounting().
+        self._spill = None
+        self._host_pool = None
+
+    # -- offload tier hooks ------------------------------------------------
+    def set_spill(self, fn) -> None:
+        """Install the device->host spill hook: ``fn(page, chain_tokens)``
+        fires inside every trie eviction BEFORE the page returns to the
+        free list (chain_tokens = the page-aligned token prefix whose KV
+        the page holds). Exceptions are swallowed — losing a spill only
+        costs a future re-prefill, never correctness."""
+        self._spill = fn
+
+    def attach_host_pool(self, pool) -> None:
+        """Surface a HostPagePool's residency in ``accounting()`` (the
+        page-conservation snapshot stays about HBM; host fields ride
+        alongside)."""
+        self._host_pool = pool
+
+    def _chain_tokens(self, node: TrieNode) -> list[int]:
+        """The full page-aligned token prefix ``node``'s page covers,
+        reconstructed by walking parent links. Empty when the chain is
+        broken (cannot happen for live trie nodes — a parent with children
+        is not evictable — but defended anyway)."""
+        keys: list[tuple[int, ...]] = []
+        cur: TrieNode | None = node
+        while cur is not None:
+            keys.append(cur.key)
+            if cur.parent < 0:
+                return [t for k in reversed(keys) for t in k]
+            cur = self._by_page.get(cur.parent)
+        return []
 
     # -- queries -----------------------------------------------------------
     @property
@@ -101,12 +136,20 @@ class PageAllocator:
         owned = sum(
             len(s.pages) - s.num_shared for s in self._seqs.values()
         )
-        return {
+        out = {
             "free": len(self._free),
             "trie": len(self._by_page),
             "owned": owned,
             "total": len(self._free) + len(self._by_page) + owned,
         }
+        if self._host_pool is not None:
+            # Tier-2 residency rides along (NOT part of the HBM page
+            # conservation sum — host pages are copies, not allocations).
+            st = self._host_pool.stats()
+            out["host_pool_pages"] = st["pages"]
+            out["host_pool_bytes"] = st["bytes"]
+            out["host_pool_capacity_bytes"] = st["capacity_bytes"]
+        return out
 
     def pages_needed(self, num_tokens: int) -> int:
         return -(-num_tokens // self.page_size)
@@ -152,12 +195,109 @@ class PageAllocator:
         return self._free.pop()
 
     def _evict(self, node: TrieNode) -> None:
+        if self._spill is not None:
+            # Host tier: copy the content out before the page is reused.
+            # The chain is reconstructed BEFORE the node leaves the trie.
+            try:
+                chain = self._chain_tokens(node)
+                if chain:
+                    self._spill(node.page, chain)
+            except Exception:  # noqa: BLE001 - offload is best-effort
+                pass
         self.evictions += 1
         del self._trie[(node.parent, node.key)]
         del self._by_page[node.page]
         if node.parent >= 0 and node.parent in self._by_page:
             self._by_page[node.parent].children -= 1
         self._free.append(node.page)
+
+    def evict_chain(self, pages: list[int]) -> int:
+        """Evict a matched prefix chain (``match_prefix`` result) AND the
+        trie subtree hanging off its tail, spilling every page through the
+        offload hook; used by tool-time parking to free HBM a blocked
+        session will not touch for seconds. The subtree matters because
+        the parked history is RE-tokenized from chat messages: the
+        generated turn's content usually re-renders to different token
+        ids than the engine emitted, so the session's own generated pages
+        sit BELOW the matched chain as a divergent continuation — exactly
+        the pages parking exists to free. Pages another live sequence
+        still references (refcount > 0) are left in place, as is
+        everything above them. Returns pages evicted."""
+        if not pages:
+            return 0
+        kids: dict[int, list[int]] = {}
+        for node in self._by_page.values():
+            kids.setdefault(node.parent, []).append(node.page)
+        n = 0
+
+        def _evict_down(page: int) -> bool:
+            nonlocal n
+            node = self._by_page.get(page)
+            if node is None:
+                return True
+            clear = True
+            for c in kids.get(page, ()):
+                clear = _evict_down(c) and clear
+            if clear and node.refcount == 0 and node.children == 0:
+                self._evict(node)
+                n += 1
+                return True
+            return False
+
+        # Tail's whole subtree first (leaf-first), then the chain upward.
+        if not _evict_down(pages[-1]):
+            return n
+        for p in reversed(pages[:-1]):
+            node = self._by_page.get(p)
+            if node is None or node.refcount > 0 or node.children > 0:
+                break
+            self._evict(node)
+            n += 1
+        return n
+
+    def promote_prefix(self, seq_id: int, tokens: list[int]) -> int:
+        """Register a LIVE sequence's leading full pages into the prefix
+        trie as shared references (extending ``num_shared``), so pages
+        just restored from the host tier become prefix hits for concurrent
+        admissions immediately — not only after the sequence finishes.
+        ``tokens`` bounds the promotion (its full pages). Stops early if
+        an equal-content chain already exists under a DIFFERENT page (a
+        live page table cannot be rewritten to dedup). Returns the number
+        of pages promoted."""
+        if not self.prefix_cache:
+            return 0
+        seq = self._seqs[seq_id]
+        P = self.page_size
+        stamp = next(self._clock)
+        full = min(len(tokens) // P, len(seq.pages))
+        parent = -1 if seq.num_shared == 0 else seq.pages[seq.num_shared - 1]
+        promoted = 0
+        for i in range(seq.num_shared, full):
+            key = tuple(tokens[i * P : (i + 1) * P])
+            page = seq.pages[i]
+            node = self._trie.get((parent, key))
+            if node is not None and node.page != page:
+                break
+            if node is None:
+                node = TrieNode(
+                    page=page, parent=parent, key=key,
+                    refcount=1, last_use=stamp,
+                )
+                self._trie[(parent, key)] = node
+                self._by_page[page] = node
+                if parent >= 0 and parent in self._by_page:
+                    self._by_page[parent].children += 1
+            else:
+                node.refcount += 1
+                node.last_use = stamp
+            seq.num_shared = i + 1
+            promoted += 1
+            parent = page
+        return promoted
+
+    def pages_of(self, seq_id: int) -> list[int]:
+        """Snapshot of a sequence's page list (restore targeting)."""
+        return list(self._seqs[seq_id].pages)
 
     def _register_pages(self, seq: SeqAlloc, tokens: list[int]) -> list[int]:
         """Donate a finished sequence's full pages to the trie; returns the

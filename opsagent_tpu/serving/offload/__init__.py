@@ -1,0 +1,31 @@
+"""Hierarchical KV cache: the host-RAM offload tier.
+
+The HBM ``PageAllocator`` trie (serving/kvcache.py) is tier 1; this package
+is tier 2 — a bounded host-RAM pool of evicted/parked KV page content, plus
+the device<->host page-copy engine and the policy layer that decides when
+pages move. The missing state between "prefix-cache hit" and "full
+re-prefill": a session whose pages were evicted under HBM pressure (or
+parked while its ReAct loop blocks on tool execution) restores them with a
+page copy instead of re-running prefill.
+
+Layout:
+
+- ``pool``   — :class:`HostPagePool`: token-chain-keyed host page store
+  with byte-bounded LRU (``OPSAGENT_KV_HOST_POOL_BYTES``).
+- ``copy``   — :class:`PageCopyEngine`: the jitted device->host gather and
+  host->device scatter programs (fixed page-count buckets so the
+  zero-post-warmup-compiles invariant survives), double-buffered pulls.
+- ``manager``— :class:`OffloadManager`: ties pool + copier to one engine's
+  cache/allocator and owns the spill/restore/park flows + telemetry.
+"""
+
+from __future__ import annotations
+
+from .copy import PageCopyEngine  # noqa: F401
+from .manager import OffloadManager  # noqa: F401
+from .pool import (  # noqa: F401
+    DEFAULT_HOST_POOL_BYTES,
+    ENV_HOST_POOL_BYTES,
+    HostPagePool,
+    host_pool_capacity_bytes,
+)

@@ -1,0 +1,142 @@
+"""Offload policy + telemetry: ties the host pool and the copy engine to
+one engine's cache/allocator.
+
+All entry points run under the engine lock (the engine owns the cache the
+copier reads/writes). The manager never raises into the serving path:
+offload is best-effort — a failed spill loses nothing but a future restore
+(the tokens re-prefill), and a failed restore falls back to re-prefill.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from ... import obs
+from ...utils.logger import get_logger
+from .copy import PageCopyEngine
+from .pool import HostPagePool, tree_nbytes
+
+log = get_logger("offload")
+
+
+class OffloadManager:
+    def __init__(
+        self,
+        pool: HostPagePool,
+        copier: PageCopyEngine,
+        page_size: int,
+    ):
+        self.pool = pool
+        self.copier = copier
+        self.page_size = page_size
+        # cumulative restore stats (bench A/B reads the deltas)
+        self.restored_tokens = 0
+        self.restored_pages = 0
+        self.spilled_pages = 0
+
+    # -- device -> host (spill) --------------------------------------------
+    def spill(
+        self, cache: Any, chains: list[tuple[int, list[int]]],
+        trigger: str = "evict",
+    ) -> int:
+        """Enqueue device->host copies for ``(page, token_chain)`` pairs;
+        the chain is the full page-aligned token prefix the page's KV
+        covers (the host-pool key). The actual pull happens at the next
+        ``flush()``. Returns the number of pages dispatched."""
+        good_pages: list[int] = []
+        metas: list[tuple[int, ...]] = []
+        for page, chain in chains:
+            if not chain or len(chain) % self.page_size != 0:
+                continue
+            good_pages.append(page)
+            metas.append(tuple(chain))
+        if not good_pages:
+            return 0
+        try:
+            self.copier.dispatch_gather(cache, good_pages, metas)
+        except Exception:  # noqa: BLE001 - offload is best-effort
+            log.exception("page spill dispatch failed (content lost to host tier)")
+            return 0
+        self.spilled_pages += len(good_pages)
+        obs.OFFLOAD_PAGES.inc(len(good_pages), dir="out")
+        obs.flight.record(
+            "offload", pages=len(good_pages), trigger=trigger,
+        )
+        return len(good_pages)
+
+    def flush(self) -> int:
+        """Pull every pending spill into the host pool. Returns pages
+        landed."""
+        if self.copier.pending_pages == 0:
+            self._observe()
+            return 0
+        n = 0
+        nbytes = 0
+        try:
+            for chain, page_tree in self.copier.flush():
+                if self.pool.put(np.asarray(chain, np.int32), page_tree):
+                    n += 1
+                    nbytes += tree_nbytes(page_tree)
+        except Exception:  # noqa: BLE001
+            log.exception("offload flush failed")
+        if nbytes:
+            obs.OFFLOAD_BYTES.inc(nbytes, dir="out")
+        self._observe()
+        return n
+
+    # -- host -> device (restore) ------------------------------------------
+    def restore(
+        self, cache: Any, dst_pages: list[int], entries: list[Any],
+        seq_id: int | None = None,
+        on_update=None,
+    ) -> tuple[Any, int]:
+        """Scatter host pool entries into freshly-allocated device pages.
+        Returns ``(new_cache, restored_tokens)``. ``on_update`` follows
+        :meth:`PageCopyEngine.scatter` (donation safety on mid-copy
+        failure)."""
+        if not entries:
+            return cache, 0
+        t0 = time.perf_counter()
+        page_trees = [e.data for e in entries]
+        cache = self.copier.scatter(
+            cache, dst_pages, page_trees, on_update=on_update
+        )
+        dt = time.perf_counter() - t0
+        tokens = len(entries) * self.page_size
+        self.restored_pages += len(entries)
+        self.restored_tokens += tokens
+        nbytes = sum(e.nbytes for e in entries)
+        obs.OFFLOAD_PAGES.inc(len(entries), dir="in")
+        obs.OFFLOAD_BYTES.inc(nbytes, dir="in")
+        obs.OFFLOAD_RESTORE_SECONDS.observe(dt)
+        obs.OFFLOAD_REPREFILL_AVOIDED.inc(tokens)
+        obs.flight.record(
+            "restore", seq_id=seq_id, pages=len(entries), tokens=tokens,
+            ms=round(dt * 1e3, 3),
+        )
+        self._observe()
+        return cache, tokens
+
+    # -- telemetry ---------------------------------------------------------
+    def _observe(self) -> None:
+        st = self.pool.stats()
+        obs.HOST_POOL_BYTES.set(float(st["bytes"]))
+        obs.HOST_POOL_PAGES.set(float(st["pages"]))
+        obs.HOST_POOL_CAPACITY.set(float(st["capacity_bytes"]))
+        drops = st["drops"]
+        seen = getattr(self, "_drops_seen", 0)
+        if drops > seen:
+            obs.HOST_POOL_DROPS.inc(drops - seen)
+            self._drops_seen = drops
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            **self.pool.stats(),
+            "restored_pages": self.restored_pages,
+            "restored_tokens": self.restored_tokens,
+            "spilled_pages": self.spilled_pages,
+            "pending_pages": self.copier.pending_pages,
+        }

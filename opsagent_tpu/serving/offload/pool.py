@@ -1,0 +1,208 @@
+"""Host-RAM KV page pool: tier 2 of the hierarchical KV cache.
+
+Entries are keyed exactly like the HBM prefix trie — by the page-aligned
+token chain whose KV the page holds — so the pool is a host-RAM extension
+of the trie: ``match`` walks the same way ``PageAllocator.match_prefix``
+does, and a chain restored from here re-registers into the trie
+(``PageAllocator.promote_prefix``) so partial restores still hit for
+concurrent admissions.
+
+The pool is byte-bounded (``OPSAGENT_KV_HOST_POOL_BYTES``, default 1 GiB):
+inserting past the bound drops least-recently-used entries. Dropping a
+mid-chain entry orphans the pages behind it (``match`` stops at the first
+miss); orphans age out by the same LRU. Correctness never depends on
+residency — a miss just means the tokens re-prefill (the tier-1 behavior).
+
+Thread safety: one lock around the index. The page payloads themselves are
+immutable numpy trees once inserted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+ENV_HOST_POOL_BYTES = "OPSAGENT_KV_HOST_POOL_BYTES"
+DEFAULT_HOST_POOL_BYTES = 1 << 30  # 1 GiB
+
+
+def host_pool_capacity_bytes(override: int | None = None) -> int:
+    """Resolve the pool byte bound: explicit override > env > default."""
+    if override is not None and override > 0:
+        return int(override)
+    raw = os.environ.get(ENV_HOST_POOL_BYTES, "")
+    try:
+        v = int(raw)
+        if v > 0:
+            return v
+    except ValueError:
+        pass
+    return DEFAULT_HOST_POOL_BYTES
+
+
+def _chain_key(tokens: "np.ndarray") -> bytes:
+    """Digest of the FULL token prefix this page chain covers. The digest
+    is the dict key; the entry stores the tokens for verification so a
+    hash collision can never alias two different histories' KV."""
+    return hashlib.blake2b(
+        np.ascontiguousarray(tokens, dtype=np.int32).tobytes(), digest_size=16
+    ).digest()
+
+
+def tree_nbytes(data: Any) -> int:
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree_util.tree_leaves(data)
+    )
+
+
+@dataclass
+class HostPage:
+    """One offloaded page: the KV content of ``tokens[-page_size:]`` given
+    the preceding context ``tokens[:-page_size]``."""
+
+    tokens: np.ndarray          # int32 [n_pages * page_size] full prefix
+    data: Any                   # numpy pytree mirroring one cache page
+    nbytes: int = 0
+    last_use: int = 0
+    key: bytes = field(default=b"", repr=False)
+
+
+class HostPagePool:
+    def __init__(
+        self,
+        page_size: int,
+        capacity_bytes: int | None = None,
+    ):
+        self.page_size = page_size
+        self.capacity_bytes = host_pool_capacity_bytes(capacity_bytes)
+        self._lock = threading.Lock()
+        self._entries: dict[bytes, HostPage] = {}
+        self._clock = 0
+        self.used_bytes = 0
+        # cumulative stats (scraped into opsagent_kv_host_pool_* gauges
+        # and the allocator's accounting() dict)
+        self.inserts = 0
+        self.hits = 0        # pages served by match()
+        self.misses = 0      # match() walks ended by a missing page
+        self.drops = 0       # LRU drops under the byte bound
+        self.rejects = 0     # single pages larger than the whole bound
+
+    # -- writing -----------------------------------------------------------
+    def put(self, tokens: list[int] | np.ndarray, data: Any) -> bool:
+        """Insert one page: ``tokens`` is the FULL page-aligned prefix
+        (length a multiple of page_size, the last page_size of which this
+        page holds), ``data`` a host numpy pytree of the page content.
+        Returns False when rejected (oversized / unaligned)."""
+        toks = np.ascontiguousarray(tokens, dtype=np.int32)
+        if toks.size == 0 or toks.size % self.page_size != 0:
+            return False
+        nbytes = tree_nbytes(data)
+        if nbytes > self.capacity_bytes:
+            with self._lock:
+                self.rejects += 1
+            return False
+        key = _chain_key(toks)
+        with self._lock:
+            self._clock += 1
+            old = self._entries.get(key)
+            if old is not None:
+                # Same chain re-offloaded (e.g. park after a trie hit):
+                # refresh recency, keep the existing payload — KV content
+                # for one token history is deterministic modulo rounding,
+                # and first-writer-wins keeps restores stable.
+                old.last_use = self._clock
+                return True
+            ent = HostPage(
+                tokens=toks, data=data, nbytes=nbytes,
+                last_use=self._clock, key=key,
+            )
+            self._entries[key] = ent
+            self.used_bytes += nbytes
+            self.inserts += 1
+            self._enforce_bound_locked()
+        return True
+
+    def _enforce_bound_locked(self) -> None:
+        while self.used_bytes > self.capacity_bytes and self._entries:
+            victim = min(self._entries.values(), key=lambda e: e.last_use)
+            del self._entries[victim.key]
+            self.used_bytes -= victim.nbytes
+            self.drops += 1
+
+    # -- reading -----------------------------------------------------------
+    def match(
+        self,
+        tokens: list[int] | np.ndarray,
+        start_page: int = 0,
+        max_pages: int | None = None,
+    ) -> list[HostPage]:
+        """Longest resident page chain covering ``tokens`` starting at
+        page index ``start_page`` (pages before it are assumed served by
+        the HBM trie). Returns the entries in chain order; an empty list
+        when the first wanted page is absent."""
+        toks = np.ascontiguousarray(tokens, dtype=np.int32)
+        P = self.page_size
+        total = toks.size // P
+        out: list[HostPage] = []
+        with self._lock:
+            self._clock += 1
+            stamp = self._clock
+            for i in range(start_page, total):
+                if max_pages is not None and len(out) >= max_pages:
+                    break
+                key = _chain_key(toks[: (i + 1) * P])
+                ent = self._entries.get(key)
+                if ent is None or not np.array_equal(
+                    ent.tokens, toks[: (i + 1) * P]
+                ):
+                    self.misses += 1
+                    break
+                ent.last_use = stamp
+                out.append(ent)
+            self.hits += len(out)
+        return out
+
+    def drop_chain(self, tokens: list[int] | np.ndarray) -> int:
+        """Drop every resident page of this token chain (tests / explicit
+        invalidation). Returns the number of pages dropped."""
+        toks = np.ascontiguousarray(tokens, dtype=np.int32)
+        P = self.page_size
+        n = 0
+        with self._lock:
+            for i in range(toks.size // P):
+                ent = self._entries.pop(_chain_key(toks[: (i + 1) * P]), None)
+                if ent is not None:
+                    self.used_bytes -= ent.nbytes
+                    n += 1
+        return n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.used_bytes = 0
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def num_pages(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "pages": len(self._entries),
+                "bytes": self.used_bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "inserts": self.inserts,
+                "hits": self.hits,
+                "misses": self.misses,
+                "drops": self.drops,
+                "rejects": self.rejects,
+            }

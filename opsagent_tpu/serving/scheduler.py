@@ -67,6 +67,10 @@ class Request:
     # at reap when sampling.logprobs was requested; accumulates across
     # engine restarts like generated_prefix.
     logprob_data: list[dict] = field(default_factory=list)
+    # Set when the request was pressure-parked to the host KV tier: its
+    # re-admission is EXPECTED to restore from the host pool, so a
+    # restore that falls back to re-prefill is a flight-ring anomaly.
+    parked: bool = False
     # Observability: the request's span handle (obs.trace.Span). The
     # scheduler thread has no ambient contextvar from the submitting
     # thread, so the span rides the Request explicitly; queue-wait is
@@ -174,14 +178,28 @@ class Scheduler:
                 )
                 req.done.set()
                 continue
-            try:
-                seq_id = self.engine.begin_request(
-                    req.prompt_ids,
-                    req.sampling,
-                    mask_fn=req.mask_fn,
-                    stream=req.on_token,
-                    trace=req.trace,
+            def _begin(r: Request) -> int:
+                return self.engine.begin_request(
+                    r.prompt_ids,
+                    r.sampling,
+                    mask_fn=r.mask_fn,
+                    stream=r.on_token,
+                    trace=r.trace,
+                    expect_restore=r.parked,
                 )
+
+            try:
+                try:
+                    seq_id = _begin(req)
+                except OutOfPages:
+                    # Offload tier: instead of queueing the new prompt
+                    # behind pages a cold session is pinning, park the
+                    # coldest running session to host RAM (it restores
+                    # instead of re-prefilling when it comes back) and
+                    # retry the admission once.
+                    if not self._park_coldest():
+                        raise
+                    seq_id = _begin(req)
             except OutOfPages:
                 # Transient: pages will free as running sequences finish.
                 still.append(req)
@@ -315,6 +333,94 @@ class Scheduler:
                 self._running[sid] = self._prefilling.pop(sid)
         return True
 
+    def _park_coldest(self) -> bool:
+        """Pressure-eviction policy (offload tier): pick the coldest
+        running session — LRU by last produced token — park its KV to the
+        host pool (engine.park_sequence), and re-queue its request with
+        the generated tokens salvaged into the prompt, exactly like the
+        slice-restart flow. The re-admission restores the pages from the
+        host pool, so the detour costs two page copies, not a re-prefill.
+        Returns True when a session was parked (the caller retries its
+        admission against the freed pages)."""
+        eng = self.engine
+        if getattr(eng, "offload", None) is None or not self._running:
+            return False
+        best: tuple[float, int] | None = None
+        for sid in self._running:
+            seq = eng.sequences.get(sid)
+            if seq is None or seq.done:
+                continue
+            last = seq.last_tok_s or seq.started_s
+            if best is None or last < best[0]:
+                best = (last, sid)
+        if best is None:
+            return False
+        sid = best[1]
+        try:
+            parked = eng.park_sequence(sid)
+        except Exception:  # noqa: BLE001 - parking is best-effort
+            log.exception("pressure parking of seq %d failed", sid)
+            return False
+        if parked is None:
+            return False  # finished while the pipeline settled: reap it
+        req = self._running.pop(sid)
+        if self._requeue_salvaged(req, parked.tokens, parked.logprob_data,
+                                  parked=True):
+            # Cold sessions go to the BACK of the queue: the new prompt
+            # the parking made room for admits first (that is the point).
+            self._waiting.append(req)
+        return True
+
+    def _requeue_salvaged(
+        self,
+        req: Request,
+        partial: list[int],
+        logprob_data: list[dict],
+        parked: bool = False,
+    ) -> bool:
+        """Fold a salvaged generation into the request so its re-admission
+        continues where it stopped: prompt += salvage, budget -= salvage,
+        penalties keep counting the salvage as output, a constrained
+        mask_fn keeps walking its FSM from where it was, and streaming
+        clients notice nothing (delivered tokens are not re-sent). Shared
+        by engine-restart recovery and pressure parking. Returns False
+        when the budget is exhausted (the request was finished instead of
+        re-queued)."""
+        from dataclasses import replace as dc_replace
+
+        req.logprob_data = req.logprob_data + logprob_data[: len(partial)]
+        req.generated_prefix = req.generated_prefix + partial
+        # sampling.max_tokens was already reduced by earlier salvages;
+        # subtract only THIS one's.
+        budget = req.sampling.max_tokens - len(partial)
+        if budget <= 0:
+            req.tokens = req.generated_prefix
+            req.finish_reason = "length"
+            req.done.set()
+            return False
+        req.prompt_ids = req.prompt_ids + partial
+        req.sampling = dc_replace(
+            req.sampling,
+            max_tokens=budget,
+            # Salvaged tokens fold into the prompt, but penalty counting
+            # must keep treating them as generated output.
+            penalty_history=tuple(req.generated_prefix),
+        )
+        if req.mask_fn is not None and partial:
+            # Wrap with only THIS salvage: the inner fn already prepends
+            # earlier salvages, so prepending the cumulative prefix would
+            # feed the FSM earlier tokens twice.
+            inner = req.mask_fn
+            req.mask_fn = (
+                lambda toks, _p=list(partial), _f=inner: _f(_p + toks)
+            )
+        req.seq_id = None
+        req.parked = parked or req.parked
+        # Time already spent generating must not count against the
+        # ADMISSION timeout of the re-admission.
+        req.enqueued_s = time.perf_counter()
+        return True
+
     def _fail_admission(self, sid: int, e: Exception) -> None:
         req = self._prefilling.pop(sid, None)
         if req is None:
@@ -361,16 +467,11 @@ class Scheduler:
         drain + re-prefill from retained prompts").
 
         For each running sequence, whatever tokens the dying engine's host
-        state still exposes are salvaged into ``generated_prefix``; the
-        request re-enters the admission queue with prompt = original
-        prompt + salvaged tokens (so the re-prefill rebuilds its full
-        context, prefix cache making it cheap when pages survive), a
-        correspondingly reduced max_tokens budget, and — for constrained
-        decoding — a mask_fn wrapped so the FSM keeps walking from where
-        it was instead of restarting at the schema root. Streaming clients
-        notice nothing: already-delivered tokens are not re-sent."""
-        from dataclasses import replace as dc_replace
-
+        state still exposes are salvaged into ``generated_prefix`` and the
+        request re-enters the admission queue (``_requeue_salvaged``: the
+        re-prefill rebuilds its full context, prefix cache making it cheap
+        when pages survive). Streaming clients notice nothing:
+        already-delivered tokens are not re-sent."""
         self._restarts += 1
         log.error(
             "engine restart %d/%d: rebuilding device state, re-admitting "
@@ -391,51 +492,21 @@ class Scheduler:
                 partial = self.engine.finish(sid)
             except Exception:  # noqa: BLE001 - device state may be gone
                 pass
-            if seq_obj is not None:
-                # Slice to the tokens actually salvaged: if finish()
-                # raised, partial is empty and keeping the entries would
-                # misalign every post-restart token's logprobs.
-                req.logprob_data = (
-                    req.logprob_data + seq_obj.logprob_data[: len(partial)]
-                )
-            req.generated_prefix = req.generated_prefix + partial
-            # sampling.max_tokens was already reduced by earlier restarts'
-            # salvage; subtract only THIS restart's.
-            budget = req.sampling.max_tokens - len(partial)
-            if budget <= 0:
-                req.tokens = req.generated_prefix
-                req.finish_reason = "length"
-                req.done.set()
-                continue
-            req.prompt_ids = req.prompt_ids + partial
-            req.sampling = dc_replace(
-                req.sampling,
-                max_tokens=budget,
-                # Salvaged tokens fold into the prompt, but penalty
-                # counting must keep treating them as generated output.
-                penalty_history=tuple(req.generated_prefix),
-            )
-            if req.mask_fn is not None and partial:
-                # Wrap with only THIS restart's salvage: after a second
-                # restart the inner fn already prepends the earlier
-                # salvage, so prepending the cumulative prefix would feed
-                # the FSM earlier tokens twice.
-                inner = req.mask_fn
-                req.mask_fn = (
-                    lambda toks, _p=list(partial), _f=inner: _f(_p + toks)
-                )
-            req.seq_id = None
-            salvaged.append(req)
+            # Slice logprobs to the tokens actually salvaged: if finish()
+            # raised, partial is empty and keeping the entries would
+            # misalign every post-restart token's logprobs.
+            if self._requeue_salvaged(
+                req, partial,
+                seq_obj.logprob_data if seq_obj is not None else [],
+            ):
+                salvaged.append(req)
         self._running.clear()
         for sid, req in list(self._prefilling.items()):
             # Not decoding yet: nothing generated, just re-admit whole.
             req.seq_id = None
+            req.enqueued_s = time.perf_counter()
             salvaged.append(req)
         self._prefilling.clear()
-        for req in salvaged:
-            # The time already spent generating must not count against the
-            # ADMISSION timeout of the re-admission.
-            req.enqueued_s = time.perf_counter()
         # Oldest first so re-admitted work keeps its queue position.
         self._waiting = salvaged + self._waiting
         # Release the dead engine's device buffers BEFORE building the
@@ -478,7 +549,14 @@ class Scheduler:
                 if not self._running:
                     if self._prefilling:
                         continue  # keep advancing admission chunks
-                    # idle: wait for work
+                    # Idle: land pending device->host page copies (the
+                    # offload double buffer's drain side), then wait.
+                    flush = getattr(self.engine, "offload_flush", None)
+                    if flush is not None:
+                        try:
+                            flush()
+                        except Exception:  # noqa: BLE001 - best-effort
+                            pass
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue

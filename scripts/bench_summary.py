@@ -171,6 +171,15 @@ def terminal_summary(paths: list[str]) -> int:
         p50 = best_sess.get("extra", {}).get("p50_ttft_ms", 0)
         print(f"sessions p50 TTFT (best of {len(sess)}): {p50:.0f} ms "
               f"({'<' if p50 < 500 else '>='} 500 ms target)")
+    soff = [d for d in tpu if d["metric"].startswith("sessions_offload")]
+    if soff:
+        e = soff[-1].get("extra", {})
+        print(
+            f"offload A/B: admission-wait p50 "
+            f"{e.get('admission_wait_p50_ms', 0)} ms (on) vs "
+            f"{e.get('off_admission_wait_p50_ms', 0)} ms (off); "
+            f"re-prefill avoided {e.get('reprefill_avoided_tokens', 0)} tok"
+        )
     agent = [d for d in tpu if d["metric"].startswith("agent_turn_ttft")]
     if agent:
         best_a = min(agent, key=lambda d: d["value"])

@@ -186,3 +186,36 @@ def test_sessions_mixed_mode_reports_both_variants():
     assert "ttft_delta_ms" in e and "tok_s_chip_delta" in e
     # The mixed phase actually dispatched mixed programs.
     assert e["metrics"]['opsagent_decode_dispatches_total{kind="mixed"}'] > 0
+
+
+def test_sessions_offload_mode_reports_ab_decision_numbers():
+    """OPSAGENT_BENCH_MODE=sessions-offload (the tier-1-safe fast-lane
+    form of the hierarchical-KV A/B stage: CPU, tiny model, small N) must
+    run the sessions workload with the offload tier OFF then ON against
+    one engine and emit BOTH phases' admission-wait p50 and re-prefill-
+    avoided token counts in ONE JSON line — the decision numbers the
+    host-RAM tier exists for."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "sessions-offload",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("sessions_offload[")
+    assert parsed["unit"] == "tok/s/chip"
+    e = parsed["extra"]
+    assert e["errors"] == 0
+    # Both phases measured and distinguishable.
+    assert e["p50_ttft_ms"] > 0 and e["off_p50_ttft_ms"] > 0
+    assert "admission_wait_p50_ms" in e and "off_admission_wait_p50_ms" in e
+    assert "admission_wait_delta_ms" in e
+    # The ON phase actually restored instead of re-prefilling (inter-round
+    # parking guarantees host-pool hits on every round >= 2 comeback); the
+    # OFF phase, with the tier detached, cannot have.
+    assert e["reprefill_avoided_tokens"] > 0
+    assert e["off_reprefill_avoided_tokens"] == 0
+    assert e["restored_tokens"] > 0

@@ -1,0 +1,441 @@
+"""Hierarchical KV cache (serving/offload): host pool semantics, allocator
+spill/promote invariants, bit-exact device round trips (fp and int8-
+quantized pages), the park-mid-conversation greedy-equivalence acceptance
+gate (with zero post-warmup compiles on the restore path), tool-time
+parking through the stack/agent surface, the re-prefill fallback anomaly,
+and eviction under concurrent writers.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opsagent_tpu import obs
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.kvcache import PageAllocator
+from opsagent_tpu.serving.offload.pool import HostPagePool, tree_nbytes
+from opsagent_tpu.serving.sampler import SamplingParams
+from opsagent_tpu.serving.scheduler import Request, Scheduler
+
+BASE = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+    num_pages=64, max_pages_per_seq=16, max_batch_size=4,
+    prefill_buckets=(16, 32), decode_block=4, seed=0,
+)
+
+# Process-wide real-compile counter (the same monitoring event the compile
+# watchdog consumes; never fires on jit-cache hits).
+_COMPILES: list[str] = []
+
+
+def _on_event(name: str, *a, **kw) -> None:
+    if name == "/jax/core/compile/backend_compile_duration":
+        _COMPILES.append(name)
+
+
+jax.monitoring.register_event_duration_secs_listener(_on_event)
+
+
+def _page_tree(value: float, page_size: int = 4) -> dict:
+    return {
+        "k": np.full((2, page_size, 1, 8), value, np.float32),
+        "v": np.full((2, page_size, 1, 8), value, np.float32),
+    }
+
+
+# -- host pool ----------------------------------------------------------------
+class TestHostPagePool:
+    def test_put_match_chain_walk(self):
+        pool = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        toks = list(range(100, 112))  # 3 full pages
+        for i in range(3):
+            assert pool.put(toks[: (i + 1) * 4], _page_tree(float(i)))
+        got = pool.match(toks)
+        assert len(got) == 3
+        assert [float(e.data["k"][0, 0, 0, 0]) for e in got] == [0.0, 1.0, 2.0]
+        # start_page skips pages the HBM trie already served.
+        assert len(pool.match(toks, start_page=1)) == 2
+        assert len(pool.match(toks, start_page=1, max_pages=1)) == 1
+        # A divergent history shares no chain.
+        assert pool.match([1, 2, 3, 4, 5, 6, 7, 8]) == []
+
+    def test_mid_chain_miss_stops_walk(self):
+        pool = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        toks = list(range(40, 52))
+        pool.put(toks[:4], _page_tree(0.0))
+        pool.put(toks[:12], _page_tree(2.0))  # page 3 present, page 2 absent
+        assert len(pool.match(toks)) == 1  # walk stops at the gap
+
+    def test_unaligned_and_empty_rejected(self):
+        pool = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        assert not pool.put([1, 2, 3], _page_tree(0.0))
+        assert not pool.put([], _page_tree(0.0))
+
+    def test_lru_drop_on_overflow_and_byte_accounting(self):
+        one = tree_nbytes(_page_tree(0.0))
+        pool = HostPagePool(page_size=4, capacity_bytes=3 * one)
+        chains = []
+        for i in range(3):
+            toks = [200 + i] * 4
+            chains.append(toks)
+            assert pool.put(toks, _page_tree(float(i)))
+        assert pool.used_bytes == 3 * one
+        # Refresh chain 0's recency; inserting a 4th must drop chain 1.
+        assert pool.match(chains[0])
+        assert pool.put([300] * 4, _page_tree(9.0))
+        assert pool.used_bytes == 3 * one
+        assert pool.drops == 1
+        assert pool.match(chains[0]) and not pool.match(chains[1])
+
+    def test_oversized_page_rejected(self):
+        pool = HostPagePool(page_size=4, capacity_bytes=16)
+        assert not pool.put([1] * 4, _page_tree(0.0))
+        assert pool.rejects == 1 and pool.used_bytes == 0
+
+    def test_env_capacity(self, monkeypatch):
+        monkeypatch.setenv("OPSAGENT_KV_HOST_POOL_BYTES", "12345")
+        assert HostPagePool(page_size=4).capacity_bytes == 12345
+        monkeypatch.setenv("OPSAGENT_KV_HOST_POOL_BYTES", "junk")
+        assert HostPagePool(page_size=4).capacity_bytes == 1 << 30
+
+    def test_eviction_under_8_concurrent_writers(self):
+        one = tree_nbytes(_page_tree(0.0))
+        pool = HostPagePool(page_size=4, capacity_bytes=8 * one)
+        errors: list[BaseException] = []
+
+        def writer(tid: int) -> None:
+            try:
+                for i in range(40):
+                    toks = [tid * 1000 + i] * 4
+                    pool.put(toks, _page_tree(float(tid)))
+                    pool.match(toks)
+                    if i % 7 == 0:
+                        pool.drop_chain(toks)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(t,)) for t in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        assert errors == []
+        st = pool.stats()
+        # The byte bound held throughout (checked at rest; enforcement is
+        # under the same lock as every mutation).
+        assert st["bytes"] <= pool.capacity_bytes
+        assert st["pages"] * one == st["bytes"]
+
+
+# -- allocator hooks ----------------------------------------------------------
+def test_allocator_spill_hook_fires_with_full_chains():
+    alloc = PageAllocator(num_pages=8, page_size=4, max_pages_per_seq=8)
+    spilled: list[tuple[int, list[int]]] = []
+    alloc.set_spill(lambda page, chain: spilled.append((page, chain)))
+    toks = list(range(1, 25))  # 24 tokens = 6 pages
+    sid = alloc.allocate(len(toks))
+    alloc.free(sid, tokens=toks)  # donate the chain
+    # Squeeze: a fresh 6-page allocation must evict trie leaves, spilling
+    # each with its FULL page-aligned token prefix.
+    sid2 = alloc.allocate(24)
+    assert spilled, "eviction did not spill"
+    for page, chain in spilled:
+        assert len(chain) % 4 == 0 and len(chain) > 0
+        assert chain == toks[: len(chain)]
+    # A raising spill hook must not break eviction: park the free list in
+    # one live allocation, then force the remaining trie pages out.
+    alloc.free(sid2)
+    alloc.set_spill(lambda *_: (_ for _ in ()).throw(RuntimeError("boom")))
+    sid3 = alloc.allocate(len(alloc._free) * 4)
+    before = alloc.evictions
+    sid4 = alloc.allocate(8)  # must evict through the raising hook
+    assert alloc.evictions > before
+    alloc.free(sid3)
+    alloc.free(sid4)
+    assert alloc.accounting()["total"] == 8
+
+
+def test_allocator_promote_prefix_registers_and_conserves():
+    alloc = PageAllocator(num_pages=16, page_size=4, max_pages_per_seq=8)
+    toks = list(range(50, 66))  # 16 tokens = 4 pages
+    sid = alloc.allocate(len(toks))
+    assert alloc.accounting()["owned"] == 4
+    promoted = alloc.promote_prefix(sid, toks[:12])  # 3 full pages
+    assert promoted == 3
+    acc = alloc.accounting()
+    assert acc["total"] == 16 and acc["owned"] == 1 and acc["trie"] == 3
+    # Concurrent admission hits the promoted chain.
+    hit = alloc.match_prefix(toks[:12])
+    assert len(hit) == 3
+    sid2 = alloc.allocate(13, prefix_pages=hit)
+    assert alloc.accounting()["total"] == 16
+    # Frees in either order keep conservation and release everything.
+    alloc.free(sid, tokens=toks)
+    alloc.free(sid2)
+    acc = alloc.accounting()
+    assert acc["total"] == 16 and acc["owned"] == 0
+
+
+def test_allocator_evict_chain_stops_at_referenced_pages():
+    alloc = PageAllocator(num_pages=16, page_size=4, max_pages_per_seq=8)
+    toks = list(range(10, 26))
+    sid = alloc.allocate(len(toks))
+    alloc.free(sid, tokens=toks)
+    chain = alloc.match_prefix(toks)
+    assert len(chain) == 4
+    # A live borrower pins the first two pages.
+    sid2 = alloc.allocate(9, prefix_pages=chain[:2])
+    n = alloc.evict_chain(chain)
+    assert n == 2  # only the unreferenced tail fell
+    alloc.free(sid2)
+    assert alloc.accounting()["total"] == 16
+
+
+# -- device round trips -------------------------------------------------------
+def _run_to_done(eng, sid):
+    while not eng.sequences[sid].done:
+        eng.step_block([sid])
+
+
+def _gather_pages_host(eng, pages):
+    """Host numpy copy of the given device pages, one tree per page."""
+    out = []
+    for p in pages:
+        out.append(jax.tree_util.tree_map(
+            lambda leaf: np.asarray(leaf[:, p]), eng.cache
+        ))
+    return out
+
+
+@pytest.mark.parametrize("kvq", ["", "int8"])
+def test_park_restore_round_trip_bit_exact(kvq):
+    """device->host->device through the pool must reproduce the KV pages
+    BIT FOR BIT — fp32 pages and int8+scale quantized pages alike."""
+    eng = Engine(EngineConfig(offload=True, kv_quantize=kvq, **BASE))
+    prompt = [257, 72, 101, 108, 108, 111, 44, 32, 119]
+    sid = eng.add_request(prompt, SamplingParams(max_tokens=7))
+    _run_to_done(eng, sid)
+    out1 = eng.finish(sid)
+    hist = prompt + out1
+    chain = eng.alloc.match_prefix(hist)
+    assert chain, "nothing donated to the trie"
+    before = _gather_pages_host(eng, chain)
+    parked = eng.park_chain(hist)
+    assert parked == len(chain) * eng.cfg.page_size
+    eng.offload_flush()
+    assert eng.offload.pool.num_pages >= len(chain)
+    # Re-admit the grown history: the pages come back via the host pool.
+    prompt2 = hist + [32, 110, 111, 119]
+    sid2 = eng.begin_request(prompt2, SamplingParams(max_tokens=4))
+    assert eng._prefilling[sid2] >= len(chain) * eng.cfg.page_size
+    restored_pages = eng.alloc.pages_of(sid2)[: len(chain)]
+    after = _gather_pages_host(eng, restored_pages)
+    for b, a in zip(before, after):
+        for lb, la in zip(
+            jax.tree_util.tree_leaves(b), jax.tree_util.tree_leaves(a)
+        ):
+            np.testing.assert_array_equal(lb, la)
+    while not eng.prefill_step(sid2):
+        pass
+    _run_to_done(eng, sid2)
+    eng.finish(sid2)
+    assert eng.alloc.accounting()["total"] == eng.cfg.num_pages
+
+
+@pytest.mark.parametrize("kvq", ["", "int8"])
+def test_parked_session_matches_never_offloaded_greedy(kvq):
+    """The tentpole acceptance gate: a session parked mid-conversation and
+    restored must produce exactly the greedy tokens of one that was never
+    offloaded — fp AND int8-quantized caches — with ZERO post-warmup XLA
+    compiles on the restore path."""
+    # 8 pages: A's decode residency (5 pages) leaves too few for B's
+    # 5-page admission — the parking policy MUST engage for B to admit.
+    kw = dict(BASE, num_pages=8, max_pages_per_seq=8,
+              prefill_buckets=(8, 16), mixed_batching=False)
+    prompt_a = [257, 3, 1, 4, 1, 5, 9, 2, 6]   # 9 tokens
+    prompt_b = [257] + list(range(60, 76))     # 17 tokens: 5 pages
+    budget_a = 10
+
+    ref = Engine(EngineConfig(kv_quantize=kvq, **kw))
+    want = ref.generate([prompt_a], SamplingParams(max_tokens=budget_a))[0]
+
+    eng = Engine(EngineConfig(offload=True, kv_quantize=kvq, **kw))
+    eng.warmup("sessions")
+    n0 = len(_COMPILES)
+    sched = Scheduler(eng)  # driven manually: deterministic interleaving
+    req_a = Request(list(prompt_a), SamplingParams(max_tokens=budget_a))
+    sched.submit(req_a)
+    sched._drain_queue()
+    sched._try_admit()
+    while sched._prefilling:
+        sched._advance_prefill()
+    assert req_a.seq_id in sched._running
+    # A generates a few tokens, then stalls (a slow client, a cold
+    # session): B's admission cannot fit and parks A to the host pool.
+    for _ in range(2):
+        eng.step_block(sorted(sched._running))
+    eng.drain()
+    req_b = Request(list(prompt_b), SamplingParams(max_tokens=4))
+    sched.submit(req_b)
+    sched._drain_queue()
+    sched._try_admit()
+    assert req_a.parked, "pressure parking did not engage"
+    assert req_a in sched._waiting
+    assert req_a.generated_prefix, "no tokens salvaged at park"
+    assert req_b.seq_id is not None
+    parks = [e for e in obs.flight.get_recorder().snapshot(kind="park")
+             if e.get("trigger") == "pressure"]
+    assert parks
+    # Run B to completion and reap it.
+    while sched._prefilling:
+        sched._advance_prefill()
+    while any(
+        not eng.sequences[s].done for s in sched._running
+        if s in eng.sequences
+    ):
+        eng.step_block(sorted(sched._running))
+    eng.drain()
+    sched._reap()
+    assert req_b.done.is_set() and not req_b.error
+    # A comes back: the admission restores its pages from the host pool.
+    sched._try_admit()
+    assert req_a.seq_id is not None, req_a.error
+    restores = obs.flight.get_recorder().snapshot(kind="restore")
+    assert restores, "re-admission did not restore from the host pool"
+    while sched._prefilling:
+        sched._advance_prefill()
+    while any(
+        not eng.sequences[s].done for s in sched._running
+        if s in eng.sequences
+    ):
+        eng.step_block(sorted(sched._running))
+    eng.drain()
+    sched._reap()
+    assert req_a.done.is_set() and not req_a.error
+    assert req_a.tokens == want, (
+        f"parked+restored {req_a.tokens} != uninterrupted {want}"
+    )
+    assert len(_COMPILES) == n0, (
+        f"{len(_COMPILES) - n0} post-warmup compiles on the park/restore "
+        f"path"
+    )
+
+
+def test_restore_fallback_reprefill_is_anomaly_and_still_correct():
+    """Host-pool entries dropped under the byte bound: a parked session's
+    comeback must fall back to re-prefill (correctness), count the
+    fallback, and ring-dump a restore_reprefill anomaly (visibility)."""
+    eng = Engine(EngineConfig(offload=True, **BASE))
+    ref = Engine(EngineConfig(**BASE))
+    prompt = [257, 8, 6, 7, 5, 3, 0, 9]
+    want = ref.generate([prompt], SamplingParams(max_tokens=6))[0]
+    sid = eng.add_request(prompt, SamplingParams(max_tokens=6))
+    _run_to_done(eng, sid)
+    out1 = eng.finish(sid)
+    assert out1 == want
+    hist = prompt + out1
+    assert eng.park_chain(hist) > 0
+    eng.offload_flush()
+    eng.offload.pool.clear()  # the LRU bound dropped everything
+    n_fb0 = obs.get_registry().snapshot().get(
+        "opsagent_offload_restore_fallbacks_total", 0.0
+    )
+    sid2 = eng.begin_request(
+        hist + [1, 2], SamplingParams(max_tokens=4), expect_restore=True
+    )
+    assert eng._prefilling[sid2] == 0  # nothing restored: full re-prefill
+    anomalies = [
+        e for e in obs.flight.get_recorder().snapshot(kind="anomaly")
+        if e.get("reason") == "restore_reprefill"
+    ]
+    assert anomalies, "fallback did not trigger the anomaly"
+    snap = obs.get_registry().snapshot()
+    assert snap.get(
+        "opsagent_offload_restore_fallbacks_total", 0.0
+    ) == n_fb0 + 1
+    while not eng.prefill_step(sid2):
+        pass
+    _run_to_done(eng, sid2)
+    eng.finish(sid2)
+    assert eng.alloc.accounting()["total"] == eng.cfg.num_pages
+
+
+def test_tool_time_parking_via_stack_and_agent_signal():
+    """ServingStack.park / api.park_session: the tool-exec signal from the
+    agent loop parks the session's chain (HBM freed, host pool filled) and
+    the next turn's admission restores it."""
+    from opsagent_tpu.serving.api import (
+        ServingStack, _stacks, install_stack, park_session,
+    )
+
+    kw = dict(BASE, num_pages=256, max_pages_per_seq=64,
+              prefill_buckets=(32, 64, 128))
+    stack = ServingStack(Engine(EngineConfig(offload=True, **kw)))
+    install_stack("tiny-park", stack)
+    try:
+        messages = [
+            {"role": "system", "content": "park test"},
+            {"role": "user", "content": "hello world, this is turn one"},
+        ]
+        resp = stack.chat_completion(
+            {"messages": messages, "max_tokens": 8, "temperature": 0}
+        )
+        messages.append({
+            "role": "assistant",
+            "content": resp["choices"][0]["message"]["content"] or "",
+        })
+        # The tpu:// scheme routing the agent loop uses (case-insensitive).
+        parked = park_session("tpu://Tiny-Park", messages)
+        assert parked > 0
+        stack.engine.offload_flush()
+        assert stack.engine.offload.pool.num_pages > 0
+        parks = obs.flight.get_recorder().snapshot(kind="park")
+        assert any(p.get("trigger") == "tool" for p in parks)
+        # Unknown model name: safe no-op.
+        assert park_session("tpu://no-such-stack", messages) == 0
+        # Next turn restores instead of re-prefilling.
+        messages.append({"role": "user", "content": "and now turn two"})
+        stack.chat_completion(
+            {"messages": messages, "max_tokens": 4, "temperature": 0}
+        )
+        restores = obs.flight.get_recorder().snapshot(kind="restore")
+        assert restores, "turn 2 did not restore the parked chain"
+        snap = obs.get_registry().snapshot()
+        assert snap.get(
+            "opsagent_offload_reprefill_avoided_tokens_total", 0.0
+        ) > 0
+    finally:
+        stack.close()
+        _stacks.pop("tiny-park", None)
+
+
+def test_accounting_exposes_host_pool_and_metrics():
+    eng = Engine(EngineConfig(offload=True, **BASE))
+    prompt = [257, 5, 6, 7, 8, 9, 10, 11]
+    sid = eng.add_request(prompt, SamplingParams(max_tokens=5))
+    _run_to_done(eng, sid)
+    out = eng.finish(sid)
+    eng.park_chain(prompt + out)
+    eng.offload_flush()
+    acc = eng.alloc.accounting()
+    assert acc["host_pool_pages"] == eng.offload.pool.num_pages > 0
+    assert acc["host_pool_bytes"] == eng.offload.pool.used_bytes > 0
+    assert acc["host_pool_capacity_bytes"] == eng.offload.pool.capacity_bytes
+    text = obs.metrics_text()
+    assert "opsagent_kv_host_pool_bytes" in text
+    assert 'opsagent_offload_pages_total{dir="out"}' in text
+
+
+def test_offload_disabled_paths_are_noops():
+    eng = Engine(EngineConfig(**BASE))
+    assert eng.offload is None
+    assert eng.park_chain([1, 2, 3, 4]) == 0
+    assert eng.offload_flush() == 0
+    with pytest.raises(RuntimeError):
+        eng.park_sequence(0)

@@ -40,18 +40,26 @@ def stack():
     s.close()
 
 
+#: Deterministic structure-closing biases: inside a string the quote wins
+#: (closes it immediately — random weights never close quotes on their
+#: own), after it '}' then ']' win wherever the grammar allows them, so
+#: the envelope completes in tens of tokens no matter the weights. The
+#: strict bias ordering also exercises mask+bias composition: a bias must
+#: never override a grammar-forbidden position.
+CLOSE_BIAS = {str(ord('"')): 100, str(ord('}')): 99, str(ord(']')): 98}
+
+
 def test_forced_function_emits_valid_call(stack):
     """tool_choice naming a function: even a random tiny model MUST emit a
     parseable tool_calls envelope calling exactly that function — the FSM
-    makes it structurally impossible not to. A logit_bias on the quote
-    byte keeps free-text string values short (random weights never close
-    quotes on their own), which also exercises mask+bias composition:
-    the bias must never override grammar-forbidden positions."""
+    makes it structurally impossible not to. CLOSE_BIAS pins the free-text
+    positions so the envelope always completes within the token budget
+    (greedy tokens are otherwise weight-dependent)."""
     resp = stack.chat_completion({
         "messages": [{"role": "user", "content": "scan the image"}],
         "tools": TOOLS,
         "tool_choice": {"type": "function", "function": {"name": "trivy"}},
-        "logit_bias": {str(ord('"')): 100},
+        "logit_bias": dict(CLOSE_BIAS),
         "max_tokens": 512, "temperature": 0,
     })
     choice = resp["choices"][0]
@@ -66,7 +74,7 @@ def test_required_constrains_to_listed_tools(stack):
         "messages": [{"role": "user", "content": "do something"}],
         "tools": TOOLS,
         "tool_choice": "required",
-        "logit_bias": {str(ord('"')): 100},
+        "logit_bias": dict(CLOSE_BIAS),
         "max_tokens": 512, "temperature": 0,
     })
     calls = resp["choices"][0]["message"]["tool_calls"]
