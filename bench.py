@@ -8,19 +8,22 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to thirteen stages in isolated
+A plain `python bench.py` orchestrates up to fourteen stages in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
 the guaranteed number), then the bench-8b int8 headline, its int4,
 int8-KV-pages, and combined int4+int8-KV variants (the fastest 8B
 variant becomes the headline), the BASELINE config-5 concurrent-sessions
 run, the sessions-mixed A/B (mixed prefill+decode batching on vs. off on
-the same workload), the sessions-offload A/B (hierarchical KV: host-RAM
-offload tier off vs. on under page pressure), the agent-turns stage
-(north-star p50 TTFT per tool-call turn), the pallas-dma kernel
-comparison (plain and kv-int8), a cold-restart TTFT probe against the
-stage-1-primed compilation cache, and last a speculative-decoding
-overhead run (its question is already measurement-closed).
+the same workload), the sessions-async A/B (one-step-lookahead async
+mixed ticks, async_depth 2 vs. 1, reporting tok/s and host-gap p50 for
+both phases plus an identical-output check), the sessions-offload A/B
+(hierarchical KV: host-RAM offload tier off vs. on under page pressure),
+the agent-turns stage (north-star p50 TTFT per tool-call turn), the
+pallas-dma kernel comparison (plain and kv-int8), a cold-restart TTFT
+probe against the stage-1-primed compilation cache, and last a
+speculative-decoding overhead run (its question is already
+measurement-closed).
 EVERY result line is printed
 and flushed the moment it exists (the driver kills this process at an
 unknown wall clock; an already-earned number must survive), and a
@@ -40,6 +43,12 @@ OPSAGENT_BENCH_MODE=sessions-mixed runs that same workload TWICE against
 one engine — mixed prefill+decode batching on, then off — and reports
 both (the one-weight-stream-per-tick delta); OPSAGENT_BENCH_MIXED=0
 pins the split tick for any other mode.
+OPSAGENT_BENCH_MODE=sessions-async runs the workload twice with the
+one-step-lookahead async mixed pipeline on (async_depth=2), then with
+synchronous ticks (depth=1), same prompt seeds — reporting tok/s,
+host-gap p50, and overlapped-commit counts for both phases plus a
+byte-identical-output verdict; OPSAGENT_BENCH_ASYNC=<depth> pins the
+depth for any other mode.
 OPSAGENT_BENCH_MODE=agent runs the north-star agent shape instead:
 multi-turn ReAct sessions (observation-as-user-message, full-history
 resend) with the prefix cache on, reporting p50 client TTFT per
@@ -254,6 +263,7 @@ def run_orchestrated() -> None:
         "OPSAGENT_BENCH_QUANT": None,
         "OPSAGENT_BENCH_KV": None,
         "OPSAGENT_BENCH_MIXED": None,
+        "OPSAGENT_BENCH_ASYNC": None,
     }
 
     def stage(env_extra: dict, min_remaining: float, tag: str,
@@ -352,6 +362,16 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         240, "sessions-mixed",
     ) if on_tpu else None
+    # Async-tick A/B on the same workload: one-step-lookahead mixed
+    # pipeline (depth=2) vs synchronous ticks (depth=1) in one child —
+    # tok/s + host-gap p50 for both phases, plus the identical-output
+    # verdict that proves the lookahead changes WHEN host work happens,
+    # never WHAT gets generated.
+    rsessasync = stage(
+        {"OPSAGENT_BENCH_MODE": "sessions-async",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        240, "sessions-async",
+    ) if on_tpu else None
     # Hierarchical-KV A/B on the same workload under page pressure:
     # offload tier off vs on (host-pool spill/park/restore) in one child.
     rsessoff = stage(
@@ -440,6 +460,17 @@ def run_orchestrated() -> None:
         extra["sessions_mixed_p50_ttft_ms"] = me.get("p50_ttft_ms")
         extra["sessions_split_tok_s_chip"] = me.get("split_tok_s_chip")
         extra["sessions_split_p50_ttft_ms"] = me.get("split_p50_ttft_ms")
+    if rsessasync is not None:
+        ae = rsessasync.get("extra", {})
+        extra["sessions_async_tok_s_chip"] = rsessasync["value"]
+        extra["sessions_async_host_gap_p50_ms"] = ae.get("host_gap_p50_ms")
+        extra["sessions_async_sync_tok_s_chip"] = ae.get("sync_tok_s_chip")
+        extra["sessions_async_sync_host_gap_p50_ms"] = ae.get(
+            "sync_host_gap_p50_ms"
+        )
+        extra["sessions_async_outputs_identical"] = ae.get(
+            "outputs_identical"
+        )
     if rsessoff is not None:
         oe = rsessoff.get("extra", {})
         extra["sessions_offload_tok_s_chip"] = rsessoff["value"]
@@ -512,7 +543,8 @@ def run_single() -> None:
     # (decode_block x (pipeline_depth + 1) tokens are pre-booked).
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
-    if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload"):
+    if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
+                "sessions-async"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -520,6 +552,14 @@ def run_single() -> None:
     # OPSAGENT_BENCH_MIXED=0 pins the split prefill/decode tick; the
     # sessions-mixed stage measures both in one child.
     mixed_on = os.environ.get("OPSAGENT_BENCH_MIXED", "") != "0"
+    # One-step-lookahead async mixed ticks (EngineConfig.async_depth):
+    # OPSAGENT_BENCH_ASYNC pins a depth; the sessions-mixed A/B forces
+    # synchronous ticks — its question is one-weight-stream-per-tick,
+    # not the lookahead (the sessions-async stage owns that A/B), and
+    # pinning keeps its split phase an apples-to-apples comparison.
+    async_depth = int(os.environ.get("OPSAGENT_BENCH_ASYNC", "2") or 2)
+    if mode == "sessions-mixed":
+        async_depth = 1
     kv_quantize = os.environ.get("OPSAGENT_BENCH_KV", "")
     # Page geometry, overridable for on-chip sweeps: the XLA gather reads
     # the FULL page-table capacity (max_pages x page_size) per step
@@ -577,6 +617,7 @@ def run_single() -> None:
         speculative_k=spec_k,
         decode_block=decode_block,
         mixed_batching=mixed_on,
+        async_depth=async_depth,
         offload=(mode == "sessions-offload"),
     )
     # Fail fast on undersized sweep points: OutOfPages mid-window would
@@ -609,7 +650,8 @@ def run_single() -> None:
     # full-stack path as sessions (scheduler admission -> chunked prefill
     # -> pipelined decode), so it shares that warmup level.
     t0 = time.perf_counter()
-    if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload"):
+    if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
+                "sessions-async"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -625,6 +667,10 @@ def run_single() -> None:
         return
     if mode == "sessions-mixed":
         run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
+                           n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "sessions-async":
+        run_sessions_async(eng, model, batch, steps, prompt_len, platform,
                            n_chips, quantize, init_s, warmup_s)
         return
     if mode == "sessions-offload":
@@ -823,9 +869,11 @@ def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
                               seed_base: int, park: bool = False) -> dict:
     """Run ``batch`` concurrent multi-round chat sessions with STREAMING
     completions, measuring client-observed TTFT per round (first yielded
-    chunk, error-checked). Returns {produced, wall, ttfts, errors} —
-    self-contained client-side measurement, so two phases in one process
-    cannot contaminate each other through global perf-stat snapshots.
+    chunk, error-checked). Returns {produced, wall, ttfts, errors, texts}
+    — self-contained client-side measurement, so two phases in one
+    process cannot contaminate each other through global perf-stat
+    snapshots; ``texts`` maps (session, round) to the full completion
+    text (the sessions-async A/B's identical-output check).
     ``park=True`` parks each session's KV to the host tier between rounds
     (ServingStack.park — the tool-execution window of a real agent
     turn)."""
@@ -833,6 +881,7 @@ def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
 
     results: list[dict] = []
     errors: list[str] = []
+    texts: dict[tuple[int, int], str] = {}
     lock = threading.Lock()
 
     def session(sid: int) -> None:
@@ -879,6 +928,7 @@ def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
             messages.append({"role": "user", "content": f"continue {r}"})
             with lock:
                 results.append({"ttft": ttft, "tokens": n_tok})
+                texts[(sid, r)] = "".join(parts)
 
     t0 = time.perf_counter()
     threads = [
@@ -893,6 +943,7 @@ def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
         "wall": time.perf_counter() - t0,
         "ttfts": [r["ttft"] for r in results],
         "errors": errors,
+        "texts": texts,
     }
 
 
@@ -953,6 +1004,99 @@ def run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
                 mixed["tok_s_chip"] - split["tok_s_chip"], 1
             ),
             "errors": len(mixed["errors"]) + len(split["errors"]),
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
+            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": metrics_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    log_perf_table()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_sessions_async(eng, model, batch, steps, prompt_len, platform,
+                       n_chips, quantize, init_s, warmup_s) -> None:
+    """The async-tick A/B stage: the concurrent-sessions workload run
+    TWICE against the same engine — first with the one-step-lookahead
+    async mixed pipeline (async_depth=2: tick t+1 dispatches before tick
+    t's tokens are pulled, host post-processing overlaps device compute),
+    then with synchronous ticks (depth=1, today's behavior). SAME prompt
+    seeds both phases: byte-identical output text is part of the async
+    contract (the lookahead changes WHEN host work happens, never WHAT
+    gets generated), and running the sync phase second hands IT the
+    prefix-cache advantage — a handicap against the async phase's tok/s,
+    so an async win here is conservative. Decision numbers per phase:
+    tok/s/chip, p50 TTFT, host-gap p50 (the time the device can idle
+    between mixed dispatches — the thing the overlap shrinks), and the
+    overlapped-commit count proving host work actually ran while a newer
+    dispatch was in flight."""
+    from opsagent_tpu.serving.api import ServingStack
+
+    gen_tokens = max(16, steps // 8)
+    rounds = 3
+    phases: dict[str, dict] = {}
+    for tag, depth in (("async", 2), ("sync", 1)):
+        eng.cfg.async_depth = depth
+        get_perf_stats().reset()
+        snap0 = metrics_snapshot()
+        stack = ServingStack(eng)
+        try:
+            phases[tag] = _drive_sessions_streaming(
+                stack, batch, rounds, gen_tokens, prompt_len, 4000
+            )
+        finally:
+            stack.close()
+        r = phases[tag]
+        r["p50_ttft_ms"] = (
+            float(np.median(r["ttfts"]) * 1e3) if r["ttfts"] else 0.0
+        )
+        r["tok_s_chip"] = r["produced"] / max(1e-9, r["wall"]) / n_chips
+        hg = get_perf_stats().get_stats().get("engine.step_host_gap", {})
+        r["host_gap_p50_ms"] = float(hg.get("p50", 0.0))
+        snap1 = metrics_snapshot()
+        r["overlapped_commits"] = int(
+            snap1.get("opsagent_async_overlapped_commits_total", 0)
+            - snap0.get("opsagent_async_overlapped_commits_total", 0)
+        )
+        r["async_commits"] = int(
+            snap1.get("opsagent_async_commits_total", 0)
+            - snap0.get("opsagent_async_commits_total", 0)
+        )
+        log(f"bench[sessions-async/{tag}]: {batch} sessions x {rounds} "
+            f"rounds, {r['produced']} tokens in {r['wall']:.2f}s -> "
+            f"{r['tok_s_chip']:.0f} tok/s/chip; p50 TTFT "
+            f"{r['p50_ttft_ms']:.0f} ms; host-gap p50 "
+            f"{r['host_gap_p50_ms']:.2f} ms; overlapped commits "
+            f"{r['overlapped_commits']}; errors={len(r['errors'])}")
+    a, s = phases["async"], phases["sync"]
+    identical = a["texts"] == s["texts"] and not a["errors"] and not s["errors"]
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": f"sessions_async[{model}{qtag},N={batch},{platform}]",
+        "value": round(a["tok_s_chip"], 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": vs_baseline(a["tok_s_chip"], model, platform),
+        "extra": {
+            "sessions": batch,
+            "rounds": rounds,
+            "p50_ttft_ms": round(a["p50_ttft_ms"], 1),
+            "host_gap_p50_ms": round(a["host_gap_p50_ms"], 3),
+            "overlapped_commits": a["overlapped_commits"],
+            "async_commits": a["async_commits"],
+            "sync_tok_s_chip": round(s["tok_s_chip"], 1),
+            "sync_p50_ttft_ms": round(s["p50_ttft_ms"], 1),
+            "sync_host_gap_p50_ms": round(s["host_gap_p50_ms"], 3),
+            "host_gap_delta_ms": round(
+                s["host_gap_p50_ms"] - a["host_gap_p50_ms"], 3
+            ),
+            "tok_s_chip_delta": round(
+                a["tok_s_chip"] - s["tok_s_chip"], 1
+            ),
+            "outputs_identical": identical,
+            "errors": len(a["errors"]) + len(s["errors"]),
             "init_s": round(init_s, 1),
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,

@@ -143,6 +143,16 @@ def build_parser() -> argparse.ArgumentParser:
              "HBM between turns",
     )
     se.add_argument(
+        "--async-depth",
+        type=int,
+        default=2,
+        help="mixed-tick dispatch pipeline depth: 2 (default) enqueues "
+             "tick t+1 before tick t's tokens are pulled to host "
+             "(decode feedback stays device-resident), overlapping "
+             "detokenize/stop-scan/streaming with device compute; "
+             "1 = synchronous ticks",
+    )
+    se.add_argument(
         "--platform",
         default="",
         choices=("", "tpu", "cpu"),
@@ -245,6 +255,7 @@ def main(argv: list[str] | None = None) -> int:
             kv_quantize=args.kv_quantize,
             speculative_k=args.speculative_k,
             offload=args.offload,
+            async_depth=args.async_depth,
         )
         return 0
 

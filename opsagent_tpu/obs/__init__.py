@@ -118,6 +118,42 @@ MIXED_BUDGET_UTILIZATION = _reg.histogram(
     "Fraction of max_step_tokens used per mixed dispatch (0..1)",
     buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
 )
+# -- async mixed serving runtime (serving/async_runtime.py) -------------------
+STEP_HOST_GAP_SECONDS = _reg.histogram(
+    "opsagent_step_host_gap_seconds",
+    "Host-side gap between consecutive mixed-tick device dispatches "
+    "(enqueue-return to next enqueue — time the device can go idle "
+    "waiting on host work), by tick mode (sync = async_depth 1, "
+    "async = one-step-lookahead pipeline)",
+    labelnames=("mode",),
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 1.0),
+)
+ASYNC_INFLIGHT_DEPTH = _reg.gauge(
+    "opsagent_async_inflight_depth",
+    "Mixed-tick dispatches currently in flight (dispatched, uncommitted)",
+)
+ASYNC_COMMITS = _reg.counter(
+    "opsagent_async_commits_total",
+    "Async mixed ticks committed (token pull + host post-processing)",
+)
+ASYNC_OVERLAPPED_COMMITS = _reg.counter(
+    "opsagent_async_overlapped_commits_total",
+    "Commits whose host work ran while a newer dispatch was still in "
+    "flight on device — the overlap the async runtime exists for",
+)
+ASYNC_OVERSHOOT_TOKENS = _reg.counter(
+    "opsagent_async_overshoot_tokens_total",
+    "Lookahead tokens discarded because their row had already finished "
+    "(stop/EOS detection lags one tick; the page booking is rolled back)",
+)
+ASYNC_FALLBACKS = _reg.counter(
+    "opsagent_async_fallbacks_total",
+    "Async mixed ticks that settled the pipeline and fell back to a "
+    "sync lane, by reason (hosted / fsm_mismatch / carry_break)",
+    labelnames=("reason",),
+)
+
 KV_PAGE_UTILIZATION = _reg.gauge(
     "opsagent_kv_page_utilization",
     "Fraction of KV-cache pages in use (0..1)",

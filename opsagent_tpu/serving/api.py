@@ -718,6 +718,11 @@ def build_engine_app(stack: ServingStack):
         }
         if getattr(eng, "offload", None) is not None:
             body["host_pool"] = eng.offload.stats()
+        if getattr(eng.cfg, "async_depth", 1) > 1:
+            body["async"] = {
+                "depth": eng.cfg.async_depth,
+                "inflight": eng.async_pending(),
+            }
         return web.json_response(body)
 
     async def completions(request: web.Request) -> web.StreamResponse:
@@ -926,6 +931,7 @@ def run_engine_server(
     kv_quantize: str = "",
     speculative_k: int = 0,
     offload: bool = False,
+    async_depth: int = 2,
 ) -> None:
     from aiohttp import web
 
@@ -952,6 +958,7 @@ def run_engine_server(
         kv_quantize=kv_quantize,
         speculative_k=speculative_k,
         offload=offload,
+        async_depth=async_depth,
         # Production server: compile everything before accepting requests
         # so no client ever pays XLA compile inside its TTFT.
         warmup=True,

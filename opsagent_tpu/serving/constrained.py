@@ -443,6 +443,20 @@ class JsonConstraint:
         return self.fsm.mask_for_state(self._state)
 
 
+def device_table_fsm(mask_fn) -> TokenFSM | None:
+    """The TokenFSM behind an engine ``mask_fn`` when — and only when —
+    its dense device tables are available: the eligibility test for the
+    async mixed lane (serving/async_runtime.py), where the grammar mask
+    must come from ON-DEVICE state because the row's sampled tokens are
+    never on host at mask time. Plain-callable masks (including the
+    salvage wrappers the scheduler installs after a restart/park) and
+    schemas whose tables exceed the memory budget return None — those
+    rows ride the hosted/split lane instead."""
+    if not isinstance(mask_fn, JsonConstraint):
+        return None
+    return mask_fn.fsm if mask_fn.fsm.dense_tables() is not None else None
+
+
 # Client-supplied schemas each pin a compiled TokenFSM ([vocab, maxlen]
 # byte matrix + per-state masks), so the per-tokenizer cache is a bounded
 # LRU, and schemas whose DFA explodes are rejected up front (the API maps

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 from ..models import llama
 from ..models.config import ModelConfig
-from .sampler import sample
+from .sampler import NEG_INF, sample
 
 
 def record_dispatch(kind: str, rows: int, steps: int) -> None:
@@ -71,6 +71,104 @@ def record_mixed_dispatch(
         obs.MIXED_BUDGET_UTILIZATION.observe(
             min(1.0, (decode_rows + prefill_tokens) / budget)
         )
+
+
+def record_async_dispatch(
+    decode_rows: int, prefill_tokens: int, budget: int, depth: int
+) -> None:
+    """Telemetry for one ASYNC mixed dispatch (engine step_mixed_async /
+    serving.async_runtime): the same composition series as the sync mixed
+    tick — the async tick is the same batch shape, just pipelined — plus
+    the in-flight-depth gauge the overlap proof reads. ``depth`` is the
+    pipeline occupancy INCLUDING this dispatch."""
+    from .. import obs
+
+    obs.DECODE_DISPATCHES.inc(kind="mixed_async")
+    obs.MIXED_DECODE_LANES.observe(max(0, decode_rows))
+    obs.MIXED_PREFILL_TOKENS.observe(max(0, prefill_tokens))
+    if budget > 0:
+        obs.MIXED_BUDGET_UTILIZATION.observe(
+            min(1.0, (decode_rows + prefill_tokens) / budget)
+        )
+    obs.ASYNC_INFLIGHT_DEPTH.set(depth)
+
+
+def record_async_commit(overlapped: bool, depth_after: int) -> None:
+    """One committed async tick: ``overlapped`` is True when the commit's
+    host work (pull, detokenize, stop-scan, streaming) ran while a newer
+    dispatch was still executing on device — the condition the whole
+    async runtime exists to create."""
+    from .. import obs
+
+    obs.ASYNC_COMMITS.inc()
+    if overlapped:
+        obs.ASYNC_OVERLAPPED_COMMITS.inc()
+    obs.ASYNC_INFLIGHT_DEPTH.set(depth_after)
+
+
+def mixed_step_carry(
+    params: Any,
+    cfg: ModelConfig,
+    tokens: jax.Array,      # [B, S] int32 host-built ragged rows (prefill
+                            # chunks; decode rows' col 0 is a placeholder
+                            # when use_carry)
+    use_carry: jax.Array,   # [B] bool: row's input token is carry_tok
+                            # (decode lane continuing from the previous
+                            # dispatch — its token never visited the host)
+    carry_tok: jax.Array,   # [B] int32 previous dispatch's sampled tokens
+    starts: jax.Array,      # [B] int32 write offsets
+    q_lens: jax.Array,      # [B] int32 (0 = inert row)
+    emits: jax.Array,       # [B] bool: row's sampled token is real output
+                            # (decode lanes + prompt-finishing chunks);
+                            # non-emitting rows keep their carry/FSM state
+    cache: Any,             # paged KV pytree (donated by the jit wrapper)
+    page_table: jax.Array,  # [B, MaxP]
+    key: jax.Array,
+    temps: jax.Array,       # [B] float32
+    top_k: jax.Array,       # [B] int32
+    top_p: jax.Array,       # [B] float32
+    dtype: jnp.dtype = jnp.bfloat16,
+    attn_impl: str = "xla",
+    mesh=None,
+    # Device-side constrained decoding, same table layout as
+    # decode_block_carry: row 0 of fsm_mask/fsm_dest is the FREE sentinel,
+    # DFA state s lives at row s+1. carry_fsm rides the dispatch chain;
+    # ov_fsm is the host-walked state for newly seated rows.
+    fsm_mask: jax.Array | None = None,
+    fsm_dest: jax.Array | None = None,
+    carry_fsm: jax.Array | None = None,   # [B] int32
+    ov_fsm: jax.Array | None = None,      # [B] int32
+) -> tuple[jax.Array, Any, jax.Array]:
+    """``llama.mixed_step`` with the sampled-token feedback DEVICE-RESIDENT:
+    each decode lane's input token is spliced from ``carry_tok`` — the
+    previous dispatch's output — so tick t+1 can be enqueued before tick
+    t's tokens are pulled to host (the one-step-lookahead mixed pipeline,
+    serving/async_runtime.py). Prefill chunk rows keep taking their tokens
+    from the host arrays (prompt ids are host state by definition).
+
+    Returns ``(toks [B], cache, fsm [B])`` where ``toks`` is BOTH the
+    pull target for the commit phase and the next dispatch's carry: for
+    emitting rows it is the sampled token, for everything else the spliced
+    input (a don't-care the host never reads). The FSM state advances only
+    on emitting rows, so a chunk whose sampled token is discarded cannot
+    corrupt a constrained row's grammar walk."""
+    first = jnp.where(use_carry, carry_tok, tokens[:, 0]).astype(jnp.int32)
+    tokens = tokens.at[:, 0].set(first)
+    logits, cache = llama.mixed_step(
+        params, cfg, tokens, starts, q_lens, cache, page_table,
+        dtype=dtype, attn_impl=attn_impl, mesh=mesh,
+    )
+    with_fsm = fsm_mask is not None
+    if with_fsm:
+        fstate = jnp.where(use_carry, carry_fsm, ov_fsm).astype(jnp.int32)
+        logits = jnp.where(fsm_mask[fstate], logits, NEG_INF)
+    tok = sample(logits, key, temps, top_k, top_p, None).astype(jnp.int32)
+    out = jnp.where(emits, tok, first)
+    if with_fsm:
+        fsm_out = jnp.where(emits, fsm_dest[fstate, tok], fstate)
+    else:
+        fsm_out = jnp.zeros_like(out)
+    return out, cache, fsm_out
 
 
 def decode_block(

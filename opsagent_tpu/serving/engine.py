@@ -172,6 +172,18 @@ class EngineConfig:
     # invariant survives arbitrary batch compositions. Kept modest — the
     # ragged Pallas kernel's VMEM accumulator scales with the bucket.
     mixed_buckets: tuple[int, ...] = (16, 32, 64, 128)
+    # One-step-lookahead ASYNC mixed ticks (serving/async_runtime.py):
+    # depth of the mixed-tick dispatch pipeline. At 2 (default) tick
+    # t+1's dispatch is enqueued BEFORE tick t's tokens are pulled — the
+    # decode lanes' sampled-token feedback stays device-resident (a
+    # carry, like the block-decode loop) — so host post-processing
+    # (detokenize, stop/EOS scan, streaming, trie bookkeeping) overlaps
+    # device compute. Stop-string/EOS detection lags one tick: the
+    # finished row's one overshoot token is discarded and its page
+    # booking rolled back. 1 = today's synchronous tick. Constrained
+    # rows ride the async lane only with dense device FSM tables;
+    # hosted-mask/logprobs/bias rows route to the sync lanes.
+    async_depth: int = 2
     max_new_tokens_default: int = 1024
     seed: int = 0
     prefix_cache: bool = True
@@ -586,8 +598,32 @@ class Engine:
             donate_argnames=("cache", "carry_tok", "carry_at", "carry_eos", "key"),
             static_argnames=("greedy",),
         )
+        def _mixed_carry(
+            params, tokens, use_carry, carry_tok, starts, qlens, emits,
+            cache, table, key, temps, top_k, top_p,
+            fsm_mask=None, fsm_dest=None, carry_fsm=None, ov_fsm=None,
+        ):
+            """The async variant of ``_mixed_sample``: decode lanes splice
+            their input token from the previous dispatch's device-resident
+            output (``carry_tok``), so tick t+1 dispatches before tick t's
+            tokens ever reach the host (serving/async_runtime.py)."""
+            from .decode_loop import mixed_step_carry
+
+            return mixed_step_carry(
+                params, mc, tokens, use_carry, carry_tok, starts, qlens,
+                emits, cache, table, key, temps, top_k, top_p,
+                dtype=dt, attn_impl=self.attn_impl, mesh=self.mesh,
+                fsm_mask=fsm_mask, fsm_dest=fsm_dest,
+                carry_fsm=carry_fsm, ov_fsm=ov_fsm,
+            )
+
         self._mixed_sample_jit = jax.jit(
             _mixed_sample, donate_argnames=("cache",)
+        )
+        # carry_tok is deliberately NOT donated: it is pulled to host at
+        # commit time, one dispatch after it fed the next tick.
+        self._mixed_carry_jit = jax.jit(
+            _mixed_carry, donate_argnames=("cache",)
         )
         self._sample_jit = jax.jit(sample)
 
@@ -638,6 +674,21 @@ class Engine:
         self._inflight_steps: dict[int, int] = {}    # seq_id -> booked steps
         self._prefilling: dict[int, int] = {}        # seq_id -> tokens done
 
+        # -- async mixed pipeline (see step_mixed_async) ---------------------
+        from .async_runtime import AsyncMixedRuntime
+
+        self._async = AsyncMixedRuntime(self)
+        # Device-resident carries for the async mixed program: the
+        # previous dispatch's sampled tokens / FSM states. Seeded by
+        # warmup so every runtime dispatch sees program-output sharding
+        # (the host-array variant compiles only once, inside warmup).
+        self._async_carry = None
+        self._async_fsm_carry = None
+        # Wall-clock stamp of the last mixed dispatch's enqueue return,
+        # shared by the sync and async tick paths: the gap to the next
+        # dispatch is the opsagent_step_host_gap_seconds observable.
+        self._mixed_gap_stamp: float | None = None
+
         if cfg.warmup:
             self.warmup()
 
@@ -654,12 +705,12 @@ class Engine:
         ),
         "sessions": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
-            "decode_greedy", "mixed", "offload",
+            "decode_greedy", "mixed", "mixed_async", "offload",
         }),
         "full": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
             "decode_single", "logprobs", "decode_greedy", "decode_sampled",
-            "fsm", "spec", "mixed", "offload",
+            "fsm", "spec", "mixed", "mixed_async", "offload",
         }),
     }
 
@@ -707,6 +758,7 @@ class Engine:
             # Re-warming a LIVE engine: settle in-flight decode state first,
             # exactly like the legacy step path (warmup's throwaway carries
             # would otherwise desync lanes still referenced by pulls).
+            self._async_settle()
             self._flush_and_invalidate()
             drop1 = jnp.full((1, MaxP), -1, jnp.int32)
             logits = None
@@ -767,6 +819,63 @@ class Engine:
                         dropB,
                         sub, zf, zi, of,
                     )
+            # Carry-chained ASYNC mixed programs: per bucket, TWO chained
+            # calls (the first sees fresh host carries, every later one
+            # the previous dispatch's outputs — different input
+            # shardings, hence two jit entries; see warm_pipeline's
+            # note), with and without dense FSM tables when "fsm" is in
+            # the level. The final carries are KEPT — runtime dispatches
+            # always chain from program outputs, so the host-array
+            # variant never recompiles inside the serving window.
+            if (
+                "mixed_async" in progs and self.cfg.mixed_batching
+                and self.cfg.async_depth > 1
+            ):
+                a_carry = self._async_carry
+                a_fsm = self._async_fsm_carry
+                if a_carry is None:
+                    a_carry = jnp.zeros((B,), jnp.int32)
+                if a_fsm is None:
+                    a_fsm = jnp.zeros((B,), jnp.int32)
+                fsm_tabs: list[tuple] = [(None, None)]
+                if "fsm" in progs:
+                    try:
+                        from .constrained import (
+                            TOOLPROMPT_SCHEMA, json_constraint,
+                        )
+
+                        con = json_constraint(
+                            self.tokenizer, TOOLPROMPT_SCHEMA
+                        )
+                        if con.fsm.dense_tables() is not None:
+                            fsm_tabs.append(
+                                self._fsm_device_tables(con.fsm)
+                            )
+                    except Exception:  # noqa: BLE001 - best-effort
+                        log.exception(
+                            "ToolPrompt async-FSM warmup failed (non-fatal)"
+                        )
+                zb = jnp.zeros((B,), bool)
+                for sb in self.cfg.mixed_buckets:
+                    for fm, fd in fsm_tabs:
+                        for _ in range(2):
+                            self._sample_key, sub = jax.random.split(
+                                self._sample_key
+                            )
+                            a_carry, self.cache, a_fsm = (
+                                self._mixed_carry_jit(
+                                    self.params,
+                                    jnp.zeros((B, sb), jnp.int32),
+                                    zb, a_carry, zi, zi, zb,
+                                    self.cache, dropB,
+                                    sub, zf, zi, of,
+                                    fsm_mask=fm, fsm_dest=fd,
+                                    carry_fsm=a_fsm, ov_fsm=zi,
+                                )
+                            )
+                self._async_carry = a_carry
+                self._async_fsm_carry = a_fsm
+                toks = a_carry
             if "decode_single" in progs:
                 self._sample_key, sub = jax.random.split(self._sample_key)
                 _, self.cache = self._decode_sample_jit(
@@ -1074,6 +1183,8 @@ class Engine:
         every batched sequence (pages freed, Sequence dropped) before the
         exception propagates."""
         with self.lock:
+            self._async_settle()
+            self._mixed_gap_stamp = None  # see step(): gap continuity ends
             try:
                 seqs = [self.sequences[s] for s in seq_ids]
                 dones = [self._prefilling[s] for s in seq_ids]
@@ -1205,6 +1316,8 @@ class Engine:
         dropped) before the exception propagates: the scheduler only ever
         holds seq_ids whose state is live."""
         with self.lock:
+            self._async_settle()
+            self._mixed_gap_stamp = None  # see step(): gap continuity ends
             seq = self.sequences[seq_id]
             done = self._prefilling[seq_id]
             n = seq.prompt_len
@@ -1324,6 +1437,7 @@ class Engine:
         DISPATCH cleans up every chunk admission, rolls back the decode
         rows' one-token page bookings, and re-raises."""
         with self.lock:
+            self._async_settle()
             while self._inflight or self._lane_of:
                 # Settle the pipelined block-decode state: its device
                 # carry tracks lane write offsets that a mixed dispatch
@@ -1410,6 +1524,14 @@ class Engine:
             temps, top_k, top_p, _ = self._sampling_arrays(slots, B)
             perf = get_perf_stats()
             t_disp = time.perf_counter()
+            # Host-gap observable (the async A/B's comparison basis): time
+            # since the previous mixed dispatch's enqueue returned — in
+            # this SYNC tick it spans the blocking token pull plus all
+            # host post-processing, the span the async runtime overlaps.
+            if self._mixed_gap_stamp is not None:
+                gap = t_disp - self._mixed_gap_stamp
+                obs.STEP_HOST_GAP_SECONDS.observe(gap, mode="sync")
+                perf.record_metric("engine.step_host_gap", gap * 1e3, "ms")
             try:
                 dev_out: list = []
                 with annotate("engine.mixed_step"), \
@@ -1428,6 +1550,7 @@ class Engine:
                         jnp.asarray(top_p),
                     )
                     dev_out.append(toks_d)
+                self._mixed_gap_stamp = time.perf_counter()
                 sampled = np.asarray(toks_d)
             except Exception:
                 # The decode rows' +1 bookings are for tokens this failed
@@ -1756,6 +1879,96 @@ class Engine:
         self._carry = None
         self._hist = None
 
+    def _async_settle(self) -> None:
+        """Commit every in-flight ASYNC mixed tick (results buffered for
+        ``async_take_results``). Sync-lane entry points call this before
+        touching sequence/allocator state the lookahead pipeline may
+        still reference — the async dual of ``_flush_and_invalidate``."""
+        if self._async.pending:
+            self._async.flush()
+
+    # -- async mixed pipeline (serving/async_runtime.py) ---------------------
+    def step_mixed_async(
+        self, decode_ids: list[int], prefill_chunks: dict[int, int]
+    ) -> tuple[dict[int, list[int]], dict[int, Any]]:
+        """The one-step-lookahead form of ``step_mixed``: dispatch this
+        tick's batch and return the COMMITTED results so far — which, at
+        ``cfg.async_depth`` > 1, lag the dispatch by up to depth-1 ticks.
+        Decode-lane feedback stays device-resident between dispatches, so
+        the host's post-processing of tick t overlaps tick t+1's device
+        execution. Same exclusions as ``step_mixed`` (the caller routes
+        hosted rows away — see ``mixed_async_hosted``); same return
+        contract, with tokens/list values since several commits may land
+        in one call."""
+        with self.lock:
+            while self._inflight or self._lane_of:
+                try:
+                    self._flush_and_invalidate()
+                except Exception:  # noqa: BLE001 - raising stream callback
+                    log.exception(
+                        "stream callback raised while settling pipelined "
+                        "state for an async mixed dispatch; row isolated"
+                    )
+            return self._async.step(decode_ids, prefill_chunks)
+
+    def async_pending(self) -> int:
+        """Dispatched-but-uncommitted async mixed ticks."""
+        with self.lock:
+            return self._async.pending
+
+    def mixed_gap_break(self) -> None:
+        """Mark a discontinuity in the mixed-tick cadence (scheduler went
+        idle): the next mixed dispatch must not count the wait as host
+        gap — opsagent_step_host_gap_seconds measures back-to-back ticks
+        only."""
+        self._mixed_gap_stamp = None
+
+    def async_take_results(self) -> tuple[dict[int, list[int]], dict[int, Any]]:
+        """Results committed by internal pipeline settles (parking,
+        warmup, sync-lane fallbacks) since the last pickup — a finished
+        admission must reach the scheduler even when its commit happened
+        outside ``step_mixed_async``."""
+        with self.lock:
+            return self._async.take_results()
+
+    def async_drain(self) -> tuple[dict[int, list[int]], dict[int, Any]]:
+        """Flush the async pipeline and return everything committed."""
+        with self.lock:
+            self._async.flush()
+            return self._async.take_results()
+
+    def mixed_async_hosted(self, seq_id: int) -> bool:
+        """True when this sequence cannot ride the ASYNC mixed lane: it
+        needs host-side per-token work (logprobs, logit bias/penalties)
+        or a constrained mask without dense device tables. Such rows
+        route the tick to the existing sync lanes (``mixed_hosted`` /
+        split path). Note the asymmetry with ``mixed_hosted``: a
+        JsonConstraint WITH device tables is async-eligible — its mask
+        comes from on-device FSM state."""
+        with self.lock:
+            s = self.sequences.get(seq_id)
+            if s is None:
+                return False
+            if s.params.logprobs or self._needs_bias(s):
+                return True
+            if s.mask_fn is None:
+                return False
+            from .constrained import device_table_fsm
+
+            return device_table_fsm(s.mask_fn) is None
+
+    def async_row_fsm(self, seq_id: int):
+        """The dense-table TokenFSM behind this row's mask, or None. The
+        scheduler uses it to keep each async dispatch on ONE shared table
+        set (mixed-schema ticks fall back to the sync lanes)."""
+        with self.lock:
+            s = self.sequences.get(seq_id)
+            if s is None:
+                return None
+            from .constrained import device_table_fsm
+
+            return device_table_fsm(s.mask_fn)
+
     def _pull_oldest(self) -> dict[int, list[int]]:
         """Pull the oldest in-flight block's tokens (the one device->host
         round trip per dispatch) and fold them into host state. Records are
@@ -1866,6 +2079,10 @@ class Engine:
         """One decode step over up to max_batch_size running sequences.
         Returns {seq_id: new_token} for sequences that advanced."""
         with self.lock:
+            self._async_settle()
+            # Host-gap continuity ends here: a non-mixed dispatch between
+            # two mixed ticks would otherwise count as a giant "gap".
+            self._mixed_gap_stamp = None
             targets = (
                 list(self.sequences) if seq_ids is None else list(seq_ids)
             )
@@ -2030,6 +2247,8 @@ class Engine:
         in the same batch still pipeline. Returns {seq_id: accepted tokens}
         for sequences that advanced this call."""
         with self.lock:
+            self._async_settle()
+            self._mixed_gap_stamp = None  # see step(): gap continuity ends
             running = [
                 s for s in self.sequences.values() if not s.done
             ] if seq_ids is None else [
@@ -2414,6 +2633,7 @@ class Engine:
         if self.offload is None:
             raise RuntimeError("park_sequence requires the offload tier")
         with self.lock:
+            self._async_settle()
             # Settle pipelined decode first: in-flight blocks may still
             # append tokens to this sequence (and their pulls roll page
             # bookings back to written content). Stream-callback raises
@@ -2473,6 +2693,11 @@ class Engine:
         incrementally."""
         with self.lock:
             out: dict[int, list[int]] = {}
+            if self._async.pending:
+                # Decode tokens fold into this drain's result; prefill
+                # completions stay buffered for async_take_results (the
+                # scheduler must still learn about them).
+                _merge_pulls(out, self._async.drain_decode())
             while self._inflight:
                 _merge_pulls(out, self._pull_oldest())
             return out

@@ -283,10 +283,17 @@ class Scheduler:
         tick); False routes the tick to the split path — no admitting
         prompts, some involved row needs host-side per-token work
         (constrained mask / logprobs / logit bias), or the budget left no
-        room for a chunk."""
+        room for a chunk.
+
+        With ``EngineConfig.async_depth`` > 1 the tick goes through the
+        one-step-lookahead pipeline instead (``_async_mixed_tick``):
+        dispatches run ahead, results lag, and this method's synchronous
+        body remains the depth-1 behavior."""
         eng = self.engine
         if not getattr(eng.cfg, "mixed_batching", False):
             return False
+        if getattr(eng.cfg, "async_depth", 1) > 1:
+            return self._async_mixed_tick()
         if not self._prefilling:
             return False
         for sid in list(self._running) + list(self._prefilling):
@@ -331,6 +338,105 @@ class Scheduler:
                 self._fail_admission(sid, res)
             elif res:
                 self._running[sid] = self._prefilling.pop(sid)
+        return True
+
+    def _fold_async_prefill(self, prefill_out: dict) -> None:
+        """Apply committed async admission outcomes: completed prompts
+        move to running, row-local failures fail just their request.
+        ``False`` entries (chunk landed, prompt unfinished) are no-ops."""
+        for sid, res in prefill_out.items():
+            if isinstance(res, Exception):
+                self._fail_admission(sid, res)
+            elif res:
+                req = self._prefilling.pop(sid, None)
+                if req is not None:
+                    self._running[sid] = req
+
+    def _async_mixed_tick(self) -> bool:
+        """The one-step-lookahead mixed tick (EngineConfig.async_depth > 1,
+        serving/async_runtime.py): plan and DISPATCH tick t+1 before tick
+        t's tokens are pulled — the engine keeps decode-lane feedback
+        device-resident, so the host work this loop does between
+        dispatches (reaping, admission planning, and the engine-side
+        detokenize/stop-scan/streaming at commit) overlaps device compute.
+        Results returned by the engine lag the dispatch by up to depth-1
+        ticks; admission completions are folded in whenever they surface.
+
+        Returns True when the tick was consumed by the async lane
+        (dispatch or pipeline settle); False routes to the sync paths —
+        no admitting work and nothing in flight (pure decode belongs to
+        the block pipeline), or an involved row needs a hosted lane."""
+        eng = self.engine
+        # Pick up results committed by internal pipeline settles
+        # (parking, warmup, sync-lane entry points) since the last tick.
+        _, p_out = eng.async_take_results()
+        self._fold_async_prefill(p_out)
+        if not self._prefilling and not eng.async_pending():
+            return False
+        # Hosted rows (and mixed-schema constrained batches) route the
+        # tick to the sync lanes — settle the pipeline first so the split
+        # path sees current host state.
+        fsm_seen = None
+        for sid in list(self._running) + list(self._prefilling):
+            hosted = eng.mixed_async_hosted(sid)
+            mismatch = False
+            if not hosted:
+                f = eng.async_row_fsm(sid)
+                if f is not None:
+                    if fsm_seen is not None and f is not fsm_seen:
+                        mismatch = True
+                    fsm_seen = f
+            if hosted or mismatch:
+                obs.ASYNC_FALLBACKS.inc(
+                    reason="hosted" if hosted else "fsm_mismatch"
+                )
+                _, p_out = eng.async_drain()
+                self._fold_async_prefill(p_out)
+                return False
+        decode_ids = sorted(
+            sid for sid in self._running
+            if sid in eng.sequences and not eng.sequences[sid].done
+        )
+        budget = eng.cfg.max_step_tokens - len(decode_ids)
+        rows_left = eng.cfg.max_batch_size - len(decode_ids)
+        cap = eng.cfg.mixed_buckets[-1]
+        chunks: dict[int, int] = {}
+        for sid in self._prefilling:
+            if budget <= 0 or rows_left <= 0:
+                break
+            try:
+                # Progress here is PLAN progress: chunks already in
+                # flight count as done, so a prompt is never re-offered.
+                done, total = eng.prefill_progress(sid)
+            except KeyError:
+                continue  # completion still in flight, or a failure path
+            c = min(total - done, budget, cap)
+            if c <= 0:
+                continue
+            chunks[sid] = c
+            budget -= c
+            rows_left -= 1
+        if not chunks:
+            if not eng.async_pending():
+                return False
+            # Every admitting prompt is fully planned (or the budget is
+            # spent) and only commits remain: settle the pipeline so the
+            # completions land, then let the next tick route pure decode
+            # to the block pipeline.
+            _, p_out = eng.async_drain()
+            self._fold_async_prefill(p_out)
+            return True
+        try:
+            _, p_out = eng.step_mixed_async(decode_ids, chunks)
+        except Exception as e:  # noqa: BLE001 - engine cleaned up already
+            # The engine dropped THIS tick's chunk admissions before
+            # re-raising (earlier in-flight ticks were salvaged); fail
+            # those requests, then let the loop's failure accounting see
+            # the dispatch error.
+            for sid in chunks:
+                self._fail_admission(sid, e)
+            raise
+        self._fold_async_prefill(p_out)
         return True
 
     def _park_coldest(self) -> bool:
@@ -515,7 +621,10 @@ class Scheduler:
         # once and OOM the rebuild. The old engine stays referenced for
         # its host-side state (allocator, sequences) in case the rebuild
         # fails — admission is host-only, so queued work survives.
-        for buf in ("params", "cache", "_carry", "_hist"):
+        for buf in (
+            "params", "cache", "_carry", "_hist",
+            "_async_carry", "_async_fsm_carry",
+        ):
             try:
                 setattr(self.engine, buf, None)
             except Exception:  # noqa: BLE001
@@ -549,7 +658,12 @@ class Scheduler:
                 if not self._running:
                     if self._prefilling:
                         continue  # keep advancing admission chunks
-                    # Idle: land pending device->host page copies (the
+                    # Idle: the mixed-tick cadence breaks here — the wait
+                    # must not be observed as host gap.
+                    gap_break = getattr(self.engine, "mixed_gap_break", None)
+                    if gap_break is not None:
+                        gap_break()
+                    # Land pending device->host page copies (the
                     # offload double buffer's drain side), then wait.
                     flush = getattr(self.engine, "offload_flush", None)
                     if flush is not None:

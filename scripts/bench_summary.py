@@ -171,6 +171,17 @@ def terminal_summary(paths: list[str]) -> int:
         p50 = best_sess.get("extra", {}).get("p50_ttft_ms", 0)
         print(f"sessions p50 TTFT (best of {len(sess)}): {p50:.0f} ms "
               f"({'<' if p50 < 500 else '>='} 500 ms target)")
+    sasync = [d for d in tpu if d["metric"].startswith("sessions_async")]
+    if sasync:
+        d = sasync[-1]
+        e = d.get("extra", {})
+        print(
+            f"async A/B: host-gap p50 {e.get('host_gap_p50_ms', 0)} ms "
+            f"(depth=2) vs {e.get('sync_host_gap_p50_ms', 0)} ms "
+            f"(depth=1); tok/s/chip {d['value']} vs "
+            f"{e.get('sync_tok_s_chip', 0)}; outputs identical: "
+            f"{e.get('outputs_identical')}"
+        )
     soff = [d for d in tpu if d["metric"].startswith("sessions_offload")]
     if soff:
         e = soff[-1].get("extra", {})

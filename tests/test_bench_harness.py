@@ -188,6 +188,40 @@ def test_sessions_mixed_mode_reports_both_variants():
     assert e["metrics"]['opsagent_decode_dispatches_total{kind="mixed"}'] > 0
 
 
+def test_sessions_async_mode_reports_overlap_and_identical_text():
+    """OPSAGENT_BENCH_MODE=sessions-async (the tier-1-safe fast-lane form
+    of the async-tick A/B stage: CPU, tiny model, small N) must run the
+    sessions workload with the one-step-lookahead pipeline (depth=2) and
+    with synchronous ticks (depth=1) against one engine and emit BOTH
+    phases in ONE JSON line. The on-phase must prove the overlap actually
+    happened (overlapped commits > 0) and — same prompt seeds — the two
+    phases' output text must be byte-identical: the lookahead changes
+    WHEN host work runs, never WHAT gets generated."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "sessions-async",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("sessions_async[")
+    assert parsed["unit"] == "tok/s/chip"
+    e = parsed["extra"]
+    assert e["errors"] == 0
+    # Both phases measured and distinguishable.
+    assert e["p50_ttft_ms"] > 0 and e["sync_p50_ttft_ms"] > 0
+    assert "host_gap_p50_ms" in e and "sync_host_gap_p50_ms" in e
+    assert "host_gap_delta_ms" in e
+    # The on-phase actually overlapped host work with device compute...
+    assert e["overlapped_commits"] > 0
+    assert e["async_commits"] > 0
+    # ...without changing a single output byte.
+    assert e["outputs_identical"] is True
+
+
 def test_sessions_offload_mode_reports_ab_decision_numbers():
     """OPSAGENT_BENCH_MODE=sessions-offload (the tier-1-safe fast-lane
     form of the hierarchical-KV A/B stage: CPU, tiny model, small N) must

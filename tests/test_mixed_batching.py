@@ -24,6 +24,10 @@ BASE = dict(
     num_pages=128, max_pages_per_seq=24, max_batch_size=4,
     prefill_buckets=(8, 16), decode_block=4,
     mixed_buckets=(4, 8, 16), max_step_tokens=32,
+    # This file tests the SYNCHRONOUS mixed tick's contract (ISSUE-2);
+    # the one-step-lookahead pipeline has its own acceptance suite in
+    # tests/test_async_runtime.py.
+    async_depth=1,
 )
 
 # Count real XLA compiles process-wide: the monitoring event fires once
