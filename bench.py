@@ -49,6 +49,11 @@ synchronous ticks (depth=1), same prompt seeds — reporting tok/s,
 host-gap p50, and overlapped-commit counts for both phases plus a
 byte-identical-output verdict; OPSAGENT_BENCH_ASYNC=<depth> pins the
 depth for any other mode.
+``--perf-gate`` (or OPSAGENT_BENCH_PERF_GATE=1) compares the
+orchestrated run's result lines against the committed
+BENCH_r*_local.jsonl baseline after the headline is printed and exits 4
+on regression — the --slo-strict twin for perf (see
+scripts/perf_gate.py / `opsagent perf-check` for the standalone gate).
 OPSAGENT_BENCH_MODE=agent runs the north-star agent shape instead:
 multi-turn ReAct sessions (observation-as-user-message, full-history
 resend) with the prefix cache on, reporting p50 client TTFT per
@@ -112,6 +117,19 @@ def metrics_snapshot() -> dict:
         return {}
 
 
+def attribution_snapshot() -> dict:
+    """The goodput ledger's roofline snapshot (obs/attribution.py):
+    modeled bytes by kind, MFU / HBM-utilization over the rate window,
+    and the measured-vs-modeled drift EMA — folded into every result
+    line so a BENCH artifact carries its own attribution."""
+    try:
+        from opsagent_tpu.obs import attribution
+
+        return attribution.snapshot()
+    except Exception:  # noqa: BLE001 - telemetry must never sink a bench
+        return {}
+
+
 def slo_verdicts() -> dict:
     """The declared-SLO verdicts (obs.slo) over this run's histograms —
     the same evaluation ``GET /api/slo`` serves and ``opsagent
@@ -129,6 +147,47 @@ def slo_strict() -> bool:
         "--slo-strict" in sys.argv[1:]
         or os.environ.get("OPSAGENT_BENCH_SLO_STRICT", "") not in ("", "0")
     )
+
+
+def perf_gate_enabled() -> bool:
+    """``--perf-gate`` (or OPSAGENT_BENCH_PERF_GATE=1): after the
+    headline line is printed, compare this run's result lines against
+    the committed BENCH_r*_local.jsonl baseline (the slo-strict twin for
+    perf regressions; orchestrator-level, since the comparison spans
+    stages)."""
+    return (
+        "--perf-gate" in sys.argv[1:]
+        or os.environ.get("OPSAGENT_BENCH_PERF_GATE", "") not in ("", "0")
+    )
+
+
+def exit_if_perf_regression(rows: list) -> None:
+    """Under ``--perf-gate``, a regression vs the committed baseline
+    fails the orchestrator with exit 4 (distinct from --slo-strict's 3).
+    Called AFTER every result line is printed, so no number is ever lost
+    to the verdict; exits only on a CONFIRMED regression — disjoint
+    metric sets (e.g. a cpu fallback run vs a tpu baseline) pass with a
+    note, because absence of evidence is the budget's business."""
+    if not perf_gate_enabled():
+        return
+    try:
+        from opsagent_tpu.cli.perfcheck import (
+            compare, default_baseline, format_report, load_rows,
+        )
+    except Exception as e:  # noqa: BLE001
+        log(f"bench: --perf-gate unavailable: {e}")
+        return
+    baseline = default_baseline()
+    if not baseline:
+        log("bench: --perf-gate: no committed baseline jsonl; skipping")
+        return
+    report = compare(
+        [r for r in rows if r is not None], load_rows(baseline)
+    )
+    log(f"bench: --perf-gate vs {os.path.basename(baseline)}:")
+    log(format_report(report))
+    if report["pass"] is False:
+        sys.exit(4)
 
 
 def exit_if_slo_breach(slo: dict) -> None:
@@ -504,6 +563,12 @@ def run_orchestrated() -> None:
     # The children already gated themselves; re-check the headline's
     # folded verdicts so the ORCHESTRATOR's exit code is the CI signal.
     exit_if_slo_breach(extra.get("slo") or {})
+    # Perf-regression gate LAST (exit 4): every earned number is already
+    # printed, so the verdict can never eat a result line.
+    exit_if_perf_regression([
+        r1, r8b, r8b4, r8bkv, r8b4kv, rsess, rsessmix, rsessasync,
+        rsessoff, ragent, rdma, rdmakv, rcold, rspec,
+    ])
 
 
 def run_single() -> None:
@@ -761,6 +826,7 @@ def run_single() -> None:
             "decode_block": eng.cfg.decode_block,
             "page_size": eng.cfg.page_size,
             "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
         },
     }), flush=True)
@@ -857,6 +923,7 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
         },
     }), flush=True)
@@ -1010,6 +1077,7 @@ def run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
         },
     }), flush=True)
@@ -1103,6 +1171,7 @@ def run_sessions_async(eng, model, batch, steps, prompt_len, platform,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
         },
     }), flush=True)
@@ -1202,6 +1271,7 @@ def run_sessions_offload(eng, model, batch, steps, prompt_len, platform,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
         },
     }), flush=True)
@@ -1359,6 +1429,7 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
             "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
         },
     }), flush=True)

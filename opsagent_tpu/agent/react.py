@@ -199,19 +199,31 @@ def _react_loop(
                 except Exception:  # noqa: BLE001 - parking is best-effort
                     parked_tokens = 0
             t_tool = time.perf_counter()
+            # Tool ENTRY and EXIT are separate flight events (phase=
+            # enter/exit): the exit carries duration + outcome, so a
+            # timeline can bound the tool-blocked window exactly and
+            # park/unpark pairs (parked_tokens on the enter) are
+            # auditable against the restore that follows.
+            _cur = obs.current_span()
+            _rid = _cur.trace.request_id if _cur is not None else None
+            enter_ev = {"tool": name, "phase": "enter", "request_id": _rid}
+            if parked_tokens:
+                enter_ev["parked_tokens"] = parked_tokens
+            obs.flight.record("tool_exec", **enter_ev)
 
             def _tool_flight(outcome: str, error: str = "") -> None:
+                dt = time.perf_counter() - t_tool
                 ev = {
-                    "tool": name, "outcome": outcome,
-                    "duration_ms": round(
-                        (time.perf_counter() - t_tool) * 1e3, 3
-                    ),
+                    "tool": name, "phase": "exit", "outcome": outcome,
+                    "duration_ms": round(dt * 1e3, 3),
+                    "request_id": _rid,
                 }
                 if parked_tokens:
                     ev["parked_tokens"] = parked_tokens
                 if error:
                     ev["error"] = error
                 obs.flight.record("tool_exec", **ev)
+                obs.attribution.record_goodput(dt, "tool_blocked")
 
             try:
                 with ps.timer(f"agent.tool.{name}"), \

@@ -95,6 +95,53 @@ def build_parser() -> argparse.ArgumentParser:
              "bench.py folded in",
     )
 
+    pc = sub.add_parser(
+        "perf-check",
+        help="compare a fresh bench jsonl against the committed "
+             "BENCH_r*_local.jsonl baseline with noise tolerances "
+             "(CI gate: exit 0 pass, 1 regression, 2 nothing comparable)",
+    )
+    pc.add_argument("current", help="fresh bench jsonl (result lines)")
+    pc.add_argument(
+        "--baseline", default="",
+        help="baseline jsonl (default: newest committed BENCH_r*_local.jsonl)",
+    )
+    pc.add_argument(
+        "--tolerance", type=float, default=None,
+        help="global relative tolerance (default 10%%, TTFT series 25%%)",
+    )
+    pc.add_argument(
+        "--tolerances", default="",
+        help="JSON file of {metric substring: tolerance} overrides",
+    )
+
+    tl = sub.add_parser(
+        "timeline",
+        help="render a request's lifecycle timeline as an ASCII Gantt "
+             "(queue -> prefill -> decode -> tool-blocked, with the "
+             "goodput split)",
+    )
+    tl.add_argument("request_id", help="request id (chatcmpl-... / req-...)")
+    tl.add_argument(
+        "--url", default="",
+        help="base URL of a running server; fetches "
+             "GET /api/timeline/{request_id}",
+    )
+    tl.add_argument(
+        "--token", default="",
+        help="bearer token for the agent server's JWT-guarded /api/ tree",
+    )
+    tl.add_argument(
+        "--file", default="",
+        help="read the timeline JSON from a file instead (e.g. the "
+             "'timeline' line of a flight anomaly dump)",
+    )
+    tl.add_argument("--width", type=int, default=64, help="gantt bar width")
+    tl.add_argument(
+        "--json", action="store_true", default=False,
+        help="print the raw timeline JSON instead of the gantt",
+    )
+
     se = sub.add_parser("serve-engine", help="run the TPU serving engine (OpenAI-compatible)")
     se.add_argument("--port", type=int, default=8000)
     se.add_argument("--host", default="0.0.0.0")
@@ -203,6 +250,58 @@ def main(argv: list[str] | None = None) -> int:
         from .slocheck import run_slo_check
 
         return run_slo_check(url=args.url, bench=args.bench)
+
+    if args.command == "perf-check":
+        from .perfcheck import run_perf_check
+
+        return run_perf_check(
+            args.current, baseline=args.baseline,
+            tolerance=args.tolerance, tolerances_file=args.tolerances,
+        )
+
+    if args.command == "timeline":
+        import json as _json
+
+        from ..obs import timeline as obs_timeline
+
+        if args.file:
+            with open(args.file) as f:
+                data = _json.load(f)
+            # Accept either a bare timeline dict or a flight-dump
+            # "timeline" context line ({"kind": "timeline", ...}).
+            tl_data = data.get("timeline", data) if isinstance(data, dict) \
+                else data
+        elif args.url:
+            import urllib.request
+
+            req = urllib.request.Request(
+                args.url.rstrip("/") + f"/api/timeline/{args.request_id}"
+            )
+            if args.token:
+                req.add_header("Authorization", f"Bearer {args.token}")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    tl_data = _json.loads(resp.read().decode())
+            except Exception as e:  # noqa: BLE001 - CLI surface
+                print(f"timeline fetch failed: {e}", file=sys.stderr)
+                return 1
+        else:
+            # Same-process assembly (useful right after an in-process
+            # `opsagent execute --model tpu://...` run).
+            tl_data = obs_timeline.assemble(args.request_id)
+            if tl_data is None:
+                print(
+                    f"unknown request_id {args.request_id!r} in this "
+                    "process; pass --url for a running server or --file "
+                    "for a dump",
+                    file=sys.stderr,
+                )
+                return 1
+        if args.json:
+            print(_json.dumps(tl_data, indent=2))
+        else:
+            print(obs_timeline.render_gantt(tl_data, width=args.width))
+        return 0
 
     if args.command == "server":
         # Precedence: flag > env (how k8s Secrets are injected,

@@ -272,9 +272,14 @@ def metrics_snapshot() -> dict:
 # them. ``flight`` owns the event ring + compile watchdog, ``slo`` the
 # declared-objective evaluation; the watchdog's listeners register at
 # import so no compile anywhere in the process escapes the count, and the
-# SLO gauges join the scrape as a collector.
+# SLO gauges join the scrape as a collector. ``attribution`` (the
+# roofline cost ledger + goodput counters) and ``timeline`` (per-request
+# phase assembly over the flight ring + trace store) complete the
+# goodput-ledger surface.
 from . import flight  # noqa: E402,F401
 from . import slo  # noqa: E402,F401
+from . import attribution  # noqa: E402,F401
+from . import timeline  # noqa: E402,F401
 
 flight.install_compile_watchdog()
 _reg.add_collector(lambda: slo.get_watchdog().collect())

@@ -126,18 +126,25 @@ class FlightRecorder:
             self._ring.append(ev)
         return ev
 
-    def anomaly(self, reason: str, **fields: Any) -> str | None:
+    def anomaly(
+        self, reason: str, count: bool = True, **fields: Any
+    ) -> str | None:
         """Record an anomaly event and dump the ring to JSONL (rate-
         limited). Returns the dump path, or None when rate-limited /
         dump-failed. Never raises: the flight recorder must not add a
-        failure mode to the path it is observing."""
-        from . import ANOMALIES
-
+        failure mode to the path it is observing. ``count=False`` skips
+        the opsagent_anomalies_total increment — for callers that run at
+        SCRAPE time (the SLO collector), where mutating a scrape-visible
+        counter would make consecutive renders of an idle registry
+        disagree."""
         ev = self.record("anomaly", reason=reason, **fields)
-        try:
-            ANOMALIES.inc(reason=reason)
-        except Exception:  # noqa: BLE001
-            pass
+        if count:
+            try:
+                from . import ANOMALIES
+
+                ANOMALIES.inc(reason=reason)
+            except Exception:  # noqa: BLE001
+                pass
         now = time.perf_counter()
         with self._lock:
             if now - self._last_dump_s < self.dump_interval_s:
@@ -170,12 +177,44 @@ class FlightRecorder:
             f.write(json.dumps(head, default=str) + "\n")
             for ev in events:
                 f.write(json.dumps(ev, default=str) + "\n")
+            for extra in self._dump_context(trigger):
+                f.write(json.dumps(extra, default=str) + "\n")
         self.last_dump_path = path
         log.warning(
             "flight recorder dumped %d events to %s (reason: %s)",
             len(events), path, reason,
         )
         return path
+
+    def _dump_context(self, trigger: dict[str, Any]) -> list[dict[str, Any]]:
+        """Postmortem context appended to every anomaly dump so the JSONL
+        is self-contained: the goodput ledger's attribution snapshot
+        (bytes-by-kind, MFU, drift — where THIS window's device time was
+        going), and, when the trigger names a request, that request's
+        assembled timeline (SLO-breach and TTFT-breach dumps then carry
+        the whole story: ring + attribution + per-phase wall clock).
+        Best-effort: a failure here must never lose the event dump."""
+        out: list[dict[str, Any]] = []
+        try:
+            from . import attribution
+
+            out.append({
+                "kind": "attribution_snapshot", **attribution.snapshot(),
+            })
+        except Exception:  # noqa: BLE001
+            pass
+        rid = trigger.get("request_id")
+        if rid:
+            try:
+                from . import timeline
+
+                tl = timeline.assemble(rid)
+                if tl is not None:
+                    tl.pop("events", None)  # the ring is already the dump
+                    out.append({"kind": "timeline", **tl})
+            except Exception:  # noqa: BLE001
+                pass
+        return out
 
     # -- reading -----------------------------------------------------------
     def snapshot(

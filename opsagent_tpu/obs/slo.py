@@ -265,20 +265,32 @@ class SLOWatchdog:
         }
 
     def _track_breach(self, v: dict[str, Any]) -> None:
-        """Breach bookkeeping: a flight-ring event on each pass->fail
+        """Breach bookkeeping: a flight-ring ANOMALY on each pass->fail
         transition (with the verdict attached, so the dump shows WHAT
-        breached), plus breached_for_s while it lasts."""
+        breached — and, via the dump's appended attribution snapshot,
+        where the device bytes were going when it happened), plus
+        breached_for_s while it lasts. The anomaly path is rate-limited
+        by the recorder, so a flapping SLO cannot fill the disk."""
         name = v["name"]
         now = time.perf_counter()
         if v["pass"] is False:
             first = self._breached_since.setdefault(name, now)
             v["breached_for_s"] = round(now - first, 3)
             if first == now:
-                from .flight import record
+                from .flight import get_recorder, record
 
                 record(
                     "slo_breach", slo=name, value=v.get("value"),
                     target=v["target"], burn_rate=v.get("burn_rate"),
+                )
+                # Dump the ring (+ attribution/timeline context) so the
+                # breach is a self-contained postmortem artifact.
+                # count=False: this can run inside a /metrics scrape, and
+                # a scrape must not mutate scrape-visible counters.
+                get_recorder().anomaly(
+                    "slo_breach", count=False, slo=name,
+                    value=v.get("value"), target=v["target"],
+                    burn_rate=v.get("burn_rate"),
                 )
         else:
             self._breached_since.pop(name, None)

@@ -1,0 +1,305 @@
+"""Goodput ledger, part 2: per-request lifecycle timelines.
+
+Traces (obs/trace.py) hold one request's span tree; the flight ring
+(obs/flight.py) holds the engine's event stream. Neither alone answers
+the operator question "where did THIS request's wall clock go — queue,
+prefill, decode, or blocked on a tool?". This module assembles both into
+one timeline per request ID:
+
+- **phases**: a non-overlapping, gap-free segmentation of the request's
+  wall clock (queued -> prefill -> decode -> tool_blocked -> ...), built
+  from the trace spans with flight tool-entry/exit events bounding the
+  tool windows exactly, and residual time labeled ``host`` (chat
+  translation, detokenize-adjacent work) so coverage is complete rather
+  than silently partial;
+- **goodput**: the per-request fraction split (decode_active vs
+  tool_blocked vs queued vs prefill vs host) — the number ROADMAP item 2
+  (Conveyor-style tool overlap) will move;
+- **events**: the flight-ring events attributable to the request
+  (admission / dispatch / ttft / tool enter+exit / park / restore /
+  finish), stitched ACROSS engine generations: a restart re-admits the
+  request under a new seq_id with the same request ID, and both
+  generations' events land in one timeline.
+
+Served at ``GET /api/timeline/{request_id}`` on both servers and
+rendered by ``opsagent timeline`` as an ASCII Gantt. Everything here is
+read-side host work — no instrumentation is added to the hot path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from .flight import get_recorder
+from .trace import Span, get_store
+
+# Span names that map onto timeline phases. Children of "decode"
+# (decode_block / mixed_step / decode_step) stay inside their parent —
+# they overlap by design under pipelining and would shred the sweep.
+PHASE_OF_SPAN = {
+    "queue_wait": "queued",
+    "prefill": "prefill",
+    "decode": "decode_active",
+    "tool_exec": "tool_blocked",
+    "detokenize": "host",
+}
+
+# Flight-event kinds attributable to a request via request_id or its
+# seq_ids (dispatch events carry seq-id lists, not request ids).
+_SEQ_LIST_KEYS = ("seq_ids", "decode_seq_ids", "prefill_seq_ids")
+
+
+def _collect_span_intervals(
+    span: Span, out: list[tuple[str, float, float, dict]], now: float
+) -> None:
+    phase = PHASE_OF_SPAN.get(span.name)
+    if phase is not None:
+        t1 = span.t1 if span.t1 is not None else now
+        attrs = dict(span.attrs)
+        attrs["span"] = span.name
+        out.append((phase, span.t0, t1, attrs))
+        if span.name != "decode":
+            return  # mapped leaves don't nest further phases
+    for child in list(span.children):
+        _collect_span_intervals(child, out, now)
+
+
+def _tool_windows_from_events(
+    events: list[dict[str, Any]]
+) -> list[tuple[str, float, float, dict]]:
+    """Pair tool_exec enter/exit flight events into exact tool-blocked
+    windows. Unpaired enters (tool still running) extend to the last
+    event's ts — visibly open rather than dropped."""
+    out: list[tuple[str, float, float, dict]] = []
+    open_enters: list[dict[str, Any]] = []
+    last_ts = max((e["ts"] for e in events), default=0.0)
+    for e in events:
+        if e.get("kind") != "tool_exec":
+            continue
+        if e.get("phase") == "enter":
+            open_enters.append(e)
+        elif e.get("phase") == "exit" and open_enters:
+            ent = open_enters.pop()
+            out.append((
+                "tool_blocked", ent["ts"], e["ts"],
+                {
+                    "tool": e.get("tool"),
+                    "outcome": e.get("outcome"),
+                    "source": "flight",
+                },
+            ))
+    for ent in open_enters:
+        out.append((
+            "tool_blocked", ent["ts"], last_ts,
+            {"tool": ent.get("tool"), "open": True, "source": "flight"},
+        ))
+    return out
+
+
+def _sweep(
+    intervals: list[tuple[str, float, float, dict]],
+    t0: float,
+    t1: float,
+    min_gap_s: float = 1e-4,
+) -> list[dict[str, Any]]:
+    """Turn possibly-overlapping phase intervals into a non-overlapping,
+    gap-free segmentation of [t0, t1]: intervals are clipped against the
+    sweep cursor in start order (same-phase duplicates — a tool window
+    seen as both a span and a flight pair — merge naturally), and any
+    residue between mapped segments becomes a ``host`` segment, so the
+    phases partition the request's wall clock completely."""
+    segs: list[dict[str, Any]] = []
+    cursor = t0
+
+    def emit(phase: str, a: float, b: float, attrs: dict | None = None):
+        if b - a <= 0:
+            return
+        if (
+            segs
+            and segs[-1]["phase"] == phase
+            and abs(segs[-1]["_t1"] - a) < 1e-9
+            and not attrs
+        ):
+            segs[-1]["_t1"] = b
+            return
+        segs.append({"phase": phase, "_t0": a, "_t1": b,
+                     **({"attrs": attrs} if attrs else {})})
+
+    for phase, a, b, attrs in sorted(intervals, key=lambda x: (x[1], -x[2])):
+        a = max(a, cursor, t0)
+        b = min(max(b, a), t1)
+        if b - a <= 0:
+            continue
+        if a - cursor > min_gap_s:
+            emit("host", cursor, a)
+        elif a > cursor:
+            a = cursor  # swallow sub-threshold gap into this segment
+        emit(phase, a, b, attrs if attrs else None)
+        cursor = b
+    if t1 - cursor > min_gap_s:
+        emit("host", cursor, t1)
+    for s in segs:
+        s["start_ms"] = round((s.pop("_t0") - t0) * 1e3, 3)
+        end = s.pop("_t1")
+        s["end_ms"] = round((end - t0) * 1e3, 3)
+        s["duration_ms"] = round(s["end_ms"] - s["start_ms"], 3)
+    return segs
+
+
+def _relevant_events(
+    request_id: str, events: list[dict[str, Any]]
+) -> tuple[list[dict[str, Any]], set[int], int]:
+    """Flight events attributable to this request, the seq_ids it wore
+    (one per engine generation it was admitted under), and the number of
+    engine restarts observed inside its event window."""
+    seq_ids: set[int] = set()
+    for e in events:
+        if e.get("request_id") == request_id and "seq_id" in e:
+            seq_ids.add(e["seq_id"])
+    picked: list[dict[str, Any]] = []
+    for e in events:
+        if e.get("request_id") == request_id:
+            picked.append(e)
+            continue
+        if e.get("seq_id") in seq_ids and "request_id" not in e:
+            picked.append(e)
+            continue
+        if any(
+            seq_ids.intersection(e.get(k) or ()) for k in _SEQ_LIST_KEYS
+        ):
+            picked.append(e)
+    restarts = 0
+    if picked:
+        lo = min(e["ts"] for e in picked)
+        hi = max(e["ts"] for e in picked)
+        for e in events:
+            if e.get("kind") == "anomaly" and e.get("reason") == "engine_restart":
+                if lo <= e["ts"] <= hi:
+                    restarts += 1
+                    picked.append(e)
+    picked.sort(key=lambda e: e["ts"])
+    return picked, seq_ids, restarts
+
+
+def assemble(request_id: str) -> dict[str, Any] | None:
+    """Build the timeline for one request from the live trace store and
+    flight ring. Returns None when NOTHING is known about the id (no
+    trace and no flight events). Works mid-flight (open spans extend to
+    now) and across engine restarts (seq_ids accumulate per generation,
+    and the trace's re-admission spans segment the second prefill/decode
+    pass like the first)."""
+    now = time.perf_counter()
+    trace = get_store().get(request_id)
+    events, seq_ids, restarts = _relevant_events(
+        request_id, get_recorder().snapshot()
+    )
+    if trace is None and not events:
+        return None
+
+    intervals: list[tuple[str, float, float, dict]] = []
+    if trace is not None:
+        t0 = trace.root.t0
+        t1 = trace.root.t1 if trace.root.t1 is not None else now
+        _collect_span_intervals(trace.root, intervals, now)
+    else:
+        # Trace evicted (ring of 512): reconstruct coarse phases from the
+        # flight events alone — admission->ttft is prefill, ttft->finish
+        # decode, per engine generation.
+        t0 = min(e["ts"] for e in events)
+        t1 = max(e["ts"] for e in events)
+        adm = {e["seq_id"]: e["ts"] for e in events
+               if e.get("kind") == "admission"}
+        ttft = {e["seq_id"]: e["ts"] for e in events
+                if e.get("kind") == "ttft"}
+        fin = {e["seq_id"]: e["ts"] for e in events
+               if e.get("kind") == "finish"}
+        for sid, a in adm.items():
+            ft = ttft.get(sid)
+            if ft is not None:
+                intervals.append(("prefill", a, ft, {"seq_id": sid}))
+                end = fin.get(sid, t1)
+                intervals.append(("decode_active", ft, end, {"seq_id": sid}))
+    intervals.extend(
+        iv for iv in _tool_windows_from_events(events)
+        if t0 <= iv[1] <= t1 or t0 <= iv[2] <= t1
+    )
+    phases = _sweep(intervals, t0, t1)
+
+    total_ms = max(1e-9, (t1 - t0) * 1e3)
+    by_phase: dict[str, float] = {}
+    for s in phases:
+        by_phase[s["phase"]] = by_phase.get(s["phase"], 0.0) + s["duration_ms"]
+    goodput = {
+        p: round(by_phase.get(p, 0.0) / total_ms, 4)
+        for p in ("decode_active", "tool_blocked", "queued", "prefill", "host")
+    }
+    goodput["coverage"] = round(sum(by_phase.values()) / total_ms, 4)
+
+    ev_out = []
+    for e in events:
+        d = dict(e)
+        d["t_ms"] = round((d.pop("ts") - t0) * 1e3, 3)
+        ev_out.append(d)
+    return {
+        "request_id": request_id,
+        "duration_ms": round(total_ms, 3),
+        "finished": trace.finished if trace is not None else None,
+        # Distinct engine generations this request's events span: one
+        # plus observed restarts. seq_ids alone cannot tell (one agent
+        # request legitimately wears one seq_id per llm turn).
+        "engine_generations": restarts + 1,
+        "engine_restarts": restarts,
+        "seq_ids": sorted(seq_ids),
+        "goodput": goodput,
+        "phases": phases,
+        "events": ev_out,
+    }
+
+
+# -- rendering ----------------------------------------------------------------
+_BAR = "#"
+_PAD = "."
+
+
+def render_gantt(timeline: dict[str, Any], width: int = 64) -> str:
+    """ASCII Gantt of a timeline dict (the `opsagent timeline` CLI body;
+    pure string math so tests drive it without a terminal)."""
+    total = max(1e-9, float(timeline.get("duration_ms", 0.0)))
+    lines = [
+        f"timeline {timeline.get('request_id', '?')}  "
+        f"{total:.1f} ms total"
+        + (
+            f"  ({timeline['engine_generations']} engine generations)"
+            if timeline.get("engine_generations", 1) > 1 else ""
+        )
+    ]
+    g = timeline.get("goodput", {})
+    if g:
+        lines.append(
+            "goodput: "
+            + "  ".join(
+                f"{p} {100.0 * g.get(p, 0.0):.1f}%"
+                for p in (
+                    "decode_active", "tool_blocked", "queued", "prefill",
+                    "host",
+                )
+                if g.get(p)
+            )
+            + f"  (coverage {100.0 * g.get('coverage', 0.0):.1f}%)"
+        )
+    name_w = max(
+        [len(p.get("phase", "")) for p in timeline.get("phases", [])] + [5]
+    )
+    for seg in timeline.get("phases", []):
+        a = int(round(seg["start_ms"] / total * width))
+        b = int(round(seg["end_ms"] / total * width))
+        b = min(width, max(b, a + 1))
+        bar = _PAD * a + _BAR * (b - a) + _PAD * (width - b)
+        attrs = seg.get("attrs") or {}
+        tag = f" tool={attrs['tool']}" if attrs.get("tool") else ""
+        lines.append(
+            f"{seg['phase']:<{name_w}s} |{bar}| "
+            f"{seg['duration_ms']:8.1f} ms{tag}"
+        )
+    return "\n".join(lines)

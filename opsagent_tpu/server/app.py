@@ -138,7 +138,9 @@ def build_app() -> web.Application:
     app.router.add_post("/api/perf/reset", handlers.perf_reset)
     app.router.add_get("/metrics", handlers.metrics)
     app.router.add_get("/api/trace/{request_id}", handlers.trace_get)
+    app.router.add_get("/api/timeline/{request_id}", handlers.timeline_get)
     app.router.add_get("/api/debug/flight", handlers.flight_get)
+    app.router.add_get("/api/debug/memory", handlers.memory_profile)
     app.router.add_get("/api/slo", handlers.slo_get)
     app.router.add_post("/api/debug/profile", handlers.profile_capture)
     return app

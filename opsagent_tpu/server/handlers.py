@@ -319,6 +319,46 @@ async def trace_get(request: web.Request) -> web.Response:
     return web.json_response(t)
 
 
+async def timeline_get(request: web.Request) -> web.Response:
+    """The assembled lifecycle timeline of one request (trace spans +
+    flight events -> non-overlapping phases, goodput split, attributable
+    events; obs/timeline.py). JWT-guarded like /api/trace: phases carry
+    tool names and the events can carry request-derived attrs."""
+    tl = obs.timeline.assemble(request.match_info["request_id"])
+    if tl is None:
+        return web.json_response({"error": "unknown request_id"}, status=404)
+    return web.json_response(tl)
+
+
+async def memory_profile(request: web.Request) -> web.Response:
+    """GET /api/debug/memory — dump the device memory profile (pprof
+    format: which buffers hold HBM right now) into the operator's
+    profile dir. Guarded exactly like /api/debug/profile: JWT via the
+    /api/ prefix, and the destination is operator-configured only."""
+    import os
+    import time as _time
+
+    from ..utils.profiling import profile_dir, save_device_memory_profile
+
+    logdir = profile_dir()
+    if not logdir:
+        return web.json_response(
+            {"error": "profiling not enabled: start the server with "
+                      "--profile-dir (or set OPSAGENT_PROFILE_DIR)"},
+            status=403,
+        )
+    stamp = _time.strftime("%Y%m%dT%H%M%S", _time.gmtime())
+    path = os.path.join(logdir, f"memory-{stamp}.prof")
+    try:
+        os.makedirs(logdir, exist_ok=True)
+        await asyncio.get_running_loop().run_in_executor(
+            None, save_device_memory_profile, path
+        )
+    except Exception as e:  # noqa: BLE001 - surfaced to the caller
+        return web.json_response({"error": str(e)}, status=500)
+    return web.json_response({"status": "saved", "path": path})
+
+
 async def flight_get(request: web.Request) -> web.Response:
     """The flight recorder's event ring (newest last): admissions,
     dispatch compositions, tool executions, compiles, anomalies.

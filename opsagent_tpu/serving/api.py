@@ -849,6 +849,49 @@ def build_engine_app(stack: ServingStack):
             )
         return web.json_response(t)
 
+    async def timeline_get(request: web.Request) -> web.Response:
+        # The request's assembled lifecycle timeline (trace spans +
+        # flight events): non-overlapping phase segments, the goodput
+        # split, and the attributable flight events — works mid-flight
+        # and across engine restarts (obs/timeline.py).
+        tl = obs.timeline.assemble(request.match_info["request_id"])
+        if tl is None:
+            return web.json_response(
+                {"error": {"message": "unknown request_id"}}, status=404
+            )
+        return web.json_response(tl)
+
+    async def memory_profile(request: web.Request) -> web.Response:
+        # GET /api/debug/memory — dump the device memory profile (pprof)
+        # into the operator-configured profile dir: live HBM page
+        # pressure, visible without waiting for a crash. Same
+        # operator-dir-only guard as /api/debug/profile: a network
+        # client must not pick the write path.
+        import os as _os
+
+        from ..utils.profiling import profile_dir, save_device_memory_profile
+
+        logdir = profile_dir()
+        if not logdir:
+            return web.json_response(
+                {"error": {"message": "profiling not enabled: start the "
+                                      "server with --profile-dir"}},
+                status=403,
+            )
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        path = _os.path.join(logdir, f"memory-{stamp}.prof")
+        loop = asyncio.get_running_loop()
+        try:
+            _os.makedirs(logdir, exist_ok=True)
+            await loop.run_in_executor(
+                None, save_device_memory_profile, path
+            )
+        except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=500
+            )
+        return web.json_response({"status": "saved", "path": path})
+
     async def flight_get(request: web.Request) -> web.Response:
         # The flight recorder's event ring: what the engine/scheduler
         # actually did, newest last. ?n= caps the event count, ?kind=
@@ -909,7 +952,9 @@ def build_engine_app(stack: ServingStack):
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/trace/{request_id}", trace_get)
+    app.router.add_get("/api/timeline/{request_id}", timeline_get)
     app.router.add_get("/api/debug/flight", flight_get)
+    app.router.add_get("/api/debug/memory", memory_profile)
     app.router.add_get("/api/slo", slo_get)
     app.router.add_post("/api/debug/profile", profile_capture)
     app.router.add_post("/v1/profile/start", profile_start)

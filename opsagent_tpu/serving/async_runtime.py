@@ -410,11 +410,26 @@ class AsyncMixedRuntime:
             obs.PREFILL_TOKENS.inc(n_prefill)
         from .decode_loop import record_async_dispatch
 
+        from ..obs.attribution import prefill_attn_positions
+
+        dec_ctx = int(sum(int(starts[lane]) + 1 for _s, lane in dec_rows))
         record_async_dispatch(
             decode_rows=len(dec_rows),
             prefill_tokens=n_prefill,
             budget=cfg.max_step_tokens,
             depth=len(self._pending) + 1,
+            attr=getattr(eng, "attr", None),
+            attr_kw=dict(
+                q_tokens=len(dec_rows) + n_prefill,
+                kv_read_tokens=dec_ctx + int(sum(
+                    d + c for _sid, _l, d, c, _f in chk_rows
+                )),
+                kv_write_tokens=len(dec_rows) + n_prefill,
+                attn_q_ctx=dec_ctx + int(sum(
+                    prefill_attn_positions(d, c)
+                    for _sid, _l, d, c, _f in chk_rows
+                )),
+            ),
         )
         self._tick_id += 1
         obs.flight.record(

@@ -33,7 +33,21 @@ from ..models.config import ModelConfig
 from .sampler import NEG_INF, sample
 
 
-def record_dispatch(kind: str, rows: int, steps: int) -> None:
+def _record_attr(kind: str, attr, attr_kw: dict | None) -> None:
+    """Forward one dispatch's composition to the goodput ledger's cost
+    model (obs/attribution.py) — the per-dispatch wall-time/byte hook.
+    Host float math only; never raises into the dispatch path."""
+    if attr is None:
+        return
+    try:
+        attr.dispatch(kind, **(attr_kw or {}))
+    except Exception:  # noqa: BLE001 - attribution must not kill serving
+        pass
+
+
+def record_dispatch(
+    kind: str, rows: int, steps: int, attr=None, attr_kw: dict | None = None
+) -> None:
     """Host-side dispatch telemetry for the decode programs in this
     module. The loop bodies themselves are jitted — their Python runs only
     at trace time, so instrumentation inside them would count compiles,
@@ -41,7 +55,8 @@ def record_dispatch(kind: str, rows: int, steps: int) -> None:
     ``kind`` is "block" (decode_block_carry), "spec"
     (speculative_block_carry), or "single" (the fused one-step path);
     ``rows`` is how many lanes got a budget and ``steps`` the largest
-    per-lane budget in the dispatch."""
+    per-lane budget in the dispatch. ``attr``/``attr_kw`` carry the
+    dispatch's roofline composition to the attribution ledger."""
     from .. import obs
 
     obs.DECODE_DISPATCHES.inc(kind=kind)
@@ -51,10 +66,12 @@ def record_dispatch(kind: str, rows: int, steps: int) -> None:
             "Budgeted lanes per decode dispatch",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128),
         ).observe(rows)
+    _record_attr(kind, attr, attr_kw)
 
 
 def record_mixed_dispatch(
-    decode_rows: int, prefill_tokens: int, budget: int
+    decode_rows: int, prefill_tokens: int, budget: int,
+    attr=None, attr_kw: dict | None = None,
 ) -> None:
     """Composition telemetry for one MIXED prefill+decode dispatch
     (engine.step_mixed): how many decode lanes rode the dispatch, how many
@@ -71,16 +88,20 @@ def record_mixed_dispatch(
         obs.MIXED_BUDGET_UTILIZATION.observe(
             min(1.0, (decode_rows + prefill_tokens) / budget)
         )
+    _record_attr("mixed", attr, attr_kw)
 
 
 def record_async_dispatch(
-    decode_rows: int, prefill_tokens: int, budget: int, depth: int
+    decode_rows: int, prefill_tokens: int, budget: int, depth: int,
+    attr=None, attr_kw: dict | None = None,
 ) -> None:
     """Telemetry for one ASYNC mixed dispatch (engine step_mixed_async /
     serving.async_runtime): the same composition series as the sync mixed
     tick — the async tick is the same batch shape, just pipelined — plus
     the in-flight-depth gauge the overlap proof reads. ``depth`` is the
-    pipeline occupancy INCLUDING this dispatch."""
+    pipeline occupancy INCLUDING this dispatch. No ``measured_s`` ever
+    rides here: the async dispatch is enqueue-only by design, so its wall
+    time is not a step-time measurement."""
     from .. import obs
 
     obs.DECODE_DISPATCHES.inc(kind="mixed_async")
@@ -91,6 +112,7 @@ def record_async_dispatch(
             min(1.0, (decode_rows + prefill_tokens) / budget)
         )
     obs.ASYNC_INFLIGHT_DEPTH.set(depth)
+    _record_attr("mixed_async", attr, attr_kw)
 
 
 def record_async_commit(overlapped: bool, depth_after: int) -> None:

@@ -430,6 +430,14 @@ class Engine:
         self.sequences: dict[int, Sequence] = {}
         self._evictions_seen = 0  # delta-sync base for the obs counter
         self._sample_key = jax.random.PRNGKey(cfg.seed + 1)
+        # Goodput ledger: the static roofline cost model pricing every
+        # dispatch from its batch composition (obs/attribution.py). Pure
+        # host float math — nothing here is jitted or device-resident, so
+        # the zero-post-warmup-compiles invariant is untouched.
+        self.attr = obs.attribution.Attribution.for_engine(
+            self.model_cfg, cfg
+        )
+        obs.attribution.set_current(self.attr)
 
         mc, dt = self.model_cfg, cfg.dtype
         from ..ops.attention import paged_attention_backend
@@ -1233,6 +1241,18 @@ class Engine:
                     bucket=bucket, rows=len(seq_ids),
                     prefill_tokens=int(sum(chunks)),
                 )
+                self.attr.dispatch(
+                    "prefill_batch",
+                    q_tokens=int(sum(chunks)),
+                    kv_read_tokens=int(
+                        sum(d + c for d, c in zip(dones, chunks))
+                    ),
+                    kv_write_tokens=int(sum(chunks)),
+                    attn_q_ctx=int(sum(
+                        obs.attribution.prefill_attn_positions(d, c)
+                        for d, c in zip(dones, chunks)
+                    )),
+                )
                 out: dict[int, Any] = {}
                 finished_rows = [
                     i for i, (seq, d, c) in enumerate(zip(seqs, dones, chunks))
@@ -1358,6 +1378,15 @@ class Engine:
                     "dispatch", op="prefill_chunk", seq_id=seq_id,
                     bucket=bucket, prefill_tokens=chunk,
                     prompt_done=done, prompt_total=n,
+                )
+                self.attr.dispatch(
+                    "prefill_chunk",
+                    q_tokens=chunk,
+                    kv_read_tokens=done,  # done already includes chunk
+                    kv_write_tokens=chunk,
+                    attn_q_ctx=obs.attribution.prefill_attn_positions(
+                        done - chunk, chunk
+                    ),
                 )
                 if done < n:
                     self._prefilling[seq_id] = done
@@ -1564,9 +1593,9 @@ class Engine:
                 for sid, *_ in chunk_info:
                     self._drop_admission(sid)
                 raise
+            measured_s = time.perf_counter() - t_disp
             perf.record_metric(
-                "engine.mixed_dispatch",
-                (time.perf_counter() - t_disp) * 1e3, "ms",
+                "engine.mixed_dispatch", measured_s * 1e3, "ms",
             )
             n_prefill = int(sum(c for *_, c in chunk_info))
             if n_prefill:
@@ -1576,10 +1605,28 @@ class Engine:
                 obs.PREFILL_TOKENS.inc(n_prefill)
             from .decode_loop import record_mixed_dispatch
 
+            # Attribution composition: decode lanes attend their whole
+            # written context; chunk rows read their prefix + chunk. The
+            # sync tick's dispatch+pull wall time is a real synchronous
+            # measurement, so it also feeds the drift gauge.
+            dec_ctx = int(sum(int(starts[i]) + 1 for i in range(len(decode))))
             record_mixed_dispatch(
                 decode_rows=len(decode),
                 prefill_tokens=n_prefill,
                 budget=self.cfg.max_step_tokens,
+                attr=self.attr,
+                attr_kw=dict(
+                    q_tokens=len(decode) + n_prefill,
+                    kv_read_tokens=dec_ctx + int(
+                        sum(d + c for _sid, _seq, d, c in chunk_info)
+                    ),
+                    kv_write_tokens=len(decode) + n_prefill,
+                    attn_q_ctx=dec_ctx + int(sum(
+                        obs.attribution.prefill_attn_positions(d, c)
+                        for _sid, _seq, d, c in chunk_info
+                    )),
+                    measured_s=measured_s,
+                ),
             )
             obs.flight.record(
                 "dispatch", op="mixed",
@@ -1792,6 +1839,7 @@ class Engine:
         anomaly: the ring dump holds the admissions and dispatch
         compositions of the seconds leading up to the slow first token."""
         obs.TTFT_SECONDS.observe(seq.ttft_s)
+        obs.attribution.record_goodput(seq.ttft_s, "prefill")
         ttft_ms = round(seq.ttft_s * 1e3, 3)
         rid = obs.flight.request_id_of(seq.trace)
         obs.flight.record(
@@ -1837,6 +1885,9 @@ class Engine:
             seq.done = True
             seq.finish_reason = "stop"
         if seq.done and seq.decode_span is not None:
+            obs.attribution.record_goodput(
+                seq.decode_span.duration_s(), "decode_active"
+            )
             seq.decode_span.close(
                 tokens=len(seq.tokens), finish_reason=seq.finish_reason
             )
@@ -2189,7 +2240,20 @@ class Engine:
             sampled = np.asarray(sampled)
             from .decode_loop import record_dispatch
 
-            record_dispatch("single", rows=len(running), steps=1)
+            step_ctx = int(sum(
+                int(write_at[i]) + 1 for i in range(len(running))
+            ))
+            record_dispatch(
+                "single", rows=len(running), steps=1,
+                attr=self.attr,
+                attr_kw=dict(
+                    q_tokens=len(running),
+                    kv_read_tokens=step_ctx,
+                    kv_write_tokens=len(running),
+                    attn_q_ctx=step_ctx,
+                    measured_s=time.perf_counter() - t_step,
+                ),
+            )
             obs.flight.record(
                 "dispatch", op="decode_single",
                 seq_ids=[s.seq_id for s in running],
@@ -2555,10 +2619,33 @@ class Engine:
                 perf.record_metric("engine.spec_blocks", 1, "blk")
             from .decode_loop import record_dispatch
 
+            # Attribution: each budgeted lane writes `b` tokens, step j
+            # attending start+j+1 positions (exact causal sum); the scan
+            # streams the weights once per SCAN STEP regardless of how
+            # few lanes carry budget (inactive lanes ride the stream).
+            attr_q = attr_read = 0
+            for lane, sid in enumerate(lane_seqs):
+                b = int(budgets[lane])
+                if sid is None or b == 0:
+                    continue
+                s0 = max(0, self.alloc.length(sid) - b)
+                attr_q += b
+                attr_read += b * s0 + b * (b + 1) // 2
             record_dispatch(
                 "spec" if speculate else "block",
                 rows=int(np.count_nonzero(budgets)),
                 steps=int(budgets.max()),
+                attr=self.attr,
+                attr_kw=dict(
+                    weight_streams=(
+                        self._spec_steps if speculate
+                        else self.cfg.decode_block
+                    ),
+                    q_tokens=attr_q,
+                    kv_read_tokens=attr_read,
+                    kv_write_tokens=attr_q,
+                    attn_q_ctx=attr_read,
+                ),
             )
             obs.flight.record(
                 "dispatch", op="spec" if speculate else "decode_block",
@@ -2669,6 +2756,9 @@ class Engine:
                 generated=len(seq.tokens),
             )
             if seq.decode_span is not None:
+                obs.attribution.record_goodput(
+                    seq.decode_span.duration_s(), "decode_active"
+                )
                 seq.decode_span.close(
                     tokens=len(seq.tokens), finish_reason="parked"
                 )
@@ -2718,6 +2808,9 @@ class Engine:
             if seq.decode_span is not None:
                 # Aborted/errored sequences can reach finish() with the
                 # decode span still open.
+                obs.attribution.record_goodput(
+                    seq.decode_span.duration_s(), "decode_active"
+                )
                 seq.decode_span.close(
                     tokens=len(seq.tokens), finish_reason=seq.finish_reason
                 )

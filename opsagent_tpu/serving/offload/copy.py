@@ -89,16 +89,33 @@ class PageCopyEngine:
     def flush(self) -> list[tuple[Any, Any]]:
         """Pull every pending gather to host numpy. Returns a flat list of
         (meta, host_page_tree): each host tree mirrors the cache structure
-        with the page axis removed (one page: ``[L, P, ...]`` leaves)."""
+        with the page axis removed (one page: ``[L, P, ...]`` leaves).
+        The blocking pull's wall time and byte volume feed the goodput
+        ledger (attribution kind="other"): offload copies ride the same
+        HBM the decode stream uses."""
+        import time
+
         out: list[tuple[Any, Any]] = []
         pending, self._pending = self._pending, []
+        if not pending:
+            return out
+        t0 = time.perf_counter()
+        nbytes = 0
         for metas, dev in pending:
             host = jax.tree_util.tree_map(np.asarray, dev)
+            nbytes += sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(host)
+            )
             for j, meta in enumerate(metas):
                 page_tree = jax.tree_util.tree_map(
                     lambda leaf, _j=j: np.ascontiguousarray(leaf[:, _j]), host
                 )
                 out.append((meta, page_tree))
+        from ...obs import attribution
+
+        attribution.record_copy(
+            nbytes, "gather", seconds=time.perf_counter() - t0
+        )
         return out
 
     @property
@@ -116,6 +133,14 @@ class PageCopyEngine:
         every chunk so the caller's cache reference never dangles on a
         donated buffer if a later chunk raises."""
         assert len(pages) == len(page_trees) and pages
+        import time
+
+        t0 = time.perf_counter()
+        nbytes = sum(
+            leaf.nbytes
+            for tree in page_trees
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
         for off in range(0, len(pages), self.buckets[-1]):
             chunk = pages[off : off + self.buckets[-1]]
             trees = page_trees[off : off + len(chunk)]
@@ -135,6 +160,11 @@ class PageCopyEngine:
                 )
             if on_update is not None:
                 on_update(cache)
+        from ...obs import attribution
+
+        attribution.record_copy(
+            nbytes, "scatter", seconds=time.perf_counter() - t0
+        )
         return cache
 
     def warm(self, cache: Any) -> Any:

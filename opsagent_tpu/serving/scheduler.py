@@ -228,6 +228,7 @@ class Scheduler:
                 "scheduler.queue_wait", wait_s * 1e3, "ms"
             )
             obs.QUEUE_WAIT_SECONDS.observe(wait_s)
+            obs.attribution.record_goodput(wait_s, "queued")
             if req.trace is not None:
                 req.trace.child("queue_wait", req.enqueued_s, now)
         self._waiting = still

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Perf-regression gate: compare a fresh bench jsonl against the
+committed BENCH_r*_local.jsonl baseline with per-metric noise
+tolerances; exit 1 on regression, 2 when nothing is comparable.
+
+    python scripts/perf_gate.py tpu_results_r06/bench.jsonl
+    python scripts/perf_gate.py fresh.jsonl --baseline BENCH_r04_local.jsonl \
+        --tolerance 0.15
+
+Thin shim over ``opsagent_tpu.cli.perfcheck`` (also reachable as
+``opsagent perf-check``) so CI can call the gate without installing the
+package. jax-free by design.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from opsagent_tpu.cli.perfcheck import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

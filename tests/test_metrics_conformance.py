@@ -69,6 +69,20 @@ def _generate_traffic():
     obs.TOOL_CALLS.inc(tool="kubectl", outcome="ok")
     obs.KV_PAGE_UTILIZATION.set(0.375)
     obs.COMPILES.inc(phase="startup")
+    # Goodput-ledger families: one priced dispatch (with a synchronous
+    # measurement, so the drift gauge + measured histogram render) and
+    # the goodput phase counters.
+    attr = obs.attribution.Attribution(
+        num_params=10_000, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, vocab_size=512, dtype_bytes=4,
+    )
+    attr.dispatch(
+        "single", q_tokens=2, kv_read_tokens=8, kv_write_tokens=2,
+        attn_q_ctx=8, measured_s=0.004,
+    )
+    obs.attribution.record_goodput(0.2, "decode_active")
+    obs.attribution.record_goodput(0.1, "tool_blocked")
+    obs.attribution.record_goodput(0.05, "queued")
     # PerfStats bridge lines.
     get_perf_stats().record_metric("engine.ttft", 12.5, "ms")
     get_perf_stats().record_metric('series"quote', 1.0, "ms")
@@ -154,6 +168,31 @@ def test_metrics_exposition_conforms():
                 f"{fam}{child}: +Inf bucket != _count"
             )
             assert (f"{fam}_sum", child) in sample_values
+
+
+def test_goodput_ledger_families_on_the_scrape():
+    """The opsagent_attr_* / opsagent_goodput_* families (the goodput
+    ledger's contract with dashboards) are present, typed, and conform —
+    the main grammar test above already walked them; this pins the names
+    so a rename is a visible contract break."""
+    _generate_traffic()
+    text = obs.metrics_text()
+    for family, kind in (
+        ("opsagent_attr_bytes_total", "counter"),
+        ("opsagent_attr_step_bytes", "gauge"),
+        ("opsagent_attr_flops_total", "counter"),
+        ("opsagent_attr_dispatches_total", "counter"),
+        ("opsagent_attr_modeled_step_seconds", "gauge"),
+        ("opsagent_attr_measured_step_seconds", "histogram"),
+        ("opsagent_attr_model_drift_ratio", "gauge"),
+        ("opsagent_attr_mfu", "gauge"),
+        ("opsagent_attr_hbm_utilization", "gauge"),
+        ("opsagent_goodput_seconds_total", "counter"),
+    ):
+        assert f"# TYPE {family} {kind}" in text, family
+    # The split's label values are the documented four kinds.
+    for k in ("weights", "kv_read", "kv_write", "other"):
+        assert f'opsagent_attr_step_bytes{{kind="{k}"}}' in text
 
 
 def test_escaped_label_values_roundtrip():
