@@ -8,7 +8,7 @@ v5e). Prints ONE JSON line on stdout:
 
     {"metric": "...", "value": N, "unit": "tok/s/chip", "vs_baseline": N}
 
-A plain `python bench.py` orchestrates up to fourteen stages in isolated
+A plain `python bench.py` orchestrates up to fifteen stages in isolated
 subprocesses under one wall-clock budget (OPSAGENT_BENCH_BUDGET, default
 850 s): the default preset first (bench-1b on TPU, tiny-test elsewhere —
 the guaranteed number), then the bench-8b int8 headline, its int4,
@@ -19,6 +19,9 @@ the same workload), the sessions-async A/B (one-step-lookahead async
 mixed ticks, async_depth 2 vs. 1, reporting tok/s and host-gap p50 for
 both phases plus an identical-output check), the sessions-offload A/B
 (hierarchical KV: host-RAM offload tier off vs. on under page pressure),
+the fleet-affinity A/B (two engine replicas behind the fleet router:
+prefix-affinity + sticky placement vs stateless least-loaded, reporting
+re-prefill-avoided tokens and p50 TTFT per phase),
 the agent-turns stage (north-star p50 TTFT per tool-call turn), the
 pallas-dma kernel comparison (plain and kv-int8), a cold-restart TTFT
 probe against the stage-1-primed compilation cache, and last a
@@ -49,6 +52,11 @@ synchronous ticks (depth=1), same prompt seeds — reporting tok/s,
 host-gap p50, and overlapped-commit counts for both phases plus a
 byte-identical-output verdict; OPSAGENT_BENCH_ASYNC=<depth> pins the
 depth for any other mode.
+OPSAGENT_BENCH_MODE=fleet-affinity runs the sessions workload over
+OPSAGENT_BENCH_REPLICAS (default 2) in-process engine replicas behind
+the fleet router, twice — prefix-affinity + sticky placement on, then
+stateless least-loaded — reporting p50 TTFT and re-prefill-avoided
+tokens for both phases in one JSON line.
 ``--perf-gate`` (or OPSAGENT_BENCH_PERF_GATE=1) compares the
 orchestrated run's result lines against the committed
 BENCH_r*_local.jsonl baseline after the headline is printed and exits 4
@@ -438,6 +446,17 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         240, "sessions-offload",
     ) if on_tpu else None
+    # Fleet-affinity A/B: the sessions workload over TWO in-process
+    # engine replicas behind the FleetRouter — prefix-affinity + sticky
+    # placement (comebacks restore from the owning replica's host pool)
+    # vs stateless least-loaded placement (comebacks usually re-prefill
+    # on the wrong replica). The decision numbers for ROADMAP item 3's
+    # fleet front-end.
+    rfleet = stage(
+        {"OPSAGENT_BENCH_MODE": "fleet-affinity",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        240, "fleet-affinity",
+    ) if on_tpu else None
     # The literal north-star metric (BASELINE: p50 TTFT per tool-call
     # turn): multi-turn ReAct-shaped sessions with the prefix cache on.
     # Reports ms, not tok/s — never a headline candidate; folded into
@@ -542,6 +561,17 @@ def run_orchestrated() -> None:
         extra["sessions_offload_reprefill_avoided_tokens"] = oe.get(
             "reprefill_avoided_tokens"
         )
+    if rfleet is not None:
+        fe = rfleet.get("extra", {})
+        extra["fleet_affinity_tok_s_chip"] = rfleet["value"]
+        extra["fleet_affinity_p50_ttft_ms"] = fe.get("p50_ttft_ms")
+        extra["fleet_affinity_reprefill_avoided_tokens"] = fe.get(
+            "reprefill_avoided_tokens"
+        )
+        extra["fleet_off_p50_ttft_ms"] = fe.get("off_p50_ttft_ms")
+        extra["fleet_off_reprefill_avoided_tokens"] = fe.get(
+            "off_reprefill_avoided_tokens"
+        )
     if ragent is not None:
         ae = ragent.get("extra", {})
         extra["agent_turn_p50_ttft_ms"] = ragent["value"]
@@ -567,7 +597,7 @@ def run_orchestrated() -> None:
     # printed, so the verdict can never eat a result line.
     exit_if_perf_regression([
         r1, r8b, r8b4, r8bkv, r8b4kv, rsess, rsessmix, rsessasync,
-        rsessoff, ragent, rdma, rdmakv, rcold, rspec,
+        rsessoff, rfleet, ragent, rdma, rdmakv, rcold, rspec,
     ])
 
 
@@ -609,7 +639,7 @@ def run_single() -> None:
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
-                "sessions-async"):
+                "sessions-async", "fleet-affinity"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -683,7 +713,7 @@ def run_single() -> None:
         decode_block=decode_block,
         mixed_batching=mixed_on,
         async_depth=async_depth,
-        offload=(mode == "sessions-offload"),
+        offload=(mode in ("sessions-offload", "fleet-affinity")),
     )
     # Fail fast on undersized sweep points: OutOfPages mid-window would
     # force-finish sequences ('length') and quietly deflate the metric.
@@ -716,7 +746,7 @@ def run_single() -> None:
     # -> pipelined decode), so it shares that warmup level.
     t0 = time.perf_counter()
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
-                "sessions-async"):
+                "sessions-async", "fleet-affinity"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -741,6 +771,10 @@ def run_single() -> None:
     if mode == "sessions-offload":
         run_sessions_offload(eng, model, batch, steps, prompt_len, platform,
                              n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "fleet-affinity":
+        run_fleet_affinity(eng, cfg, model, batch, steps, prompt_len,
+                           platform, n_chips, quantize, init_s, warmup_s)
         return
     if mode == "agent":
         # turns/gen_tokens are THE values the page-budget guard above was
@@ -1276,6 +1310,194 @@ def run_sessions_offload(eng, model, batch, steps, prompt_len, platform,
         },
     }), flush=True)
     log_perf_table()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_fleet_affinity(eng, cfg, model, batch, steps, prompt_len, platform,
+                       n_chips, quantize, init_s, warmup_s) -> None:
+    """The fleet-affinity A/B stage (serving/fleet): N in-process engine
+    replicas behind the FleetRouter, the concurrent-sessions workload
+    with tool-window parking between rounds, run TWICE — prefix-affinity
+    routing ON (sticky pinning + longest-cached-prefix placement: a
+    session's comeback lands on the replica holding its KV and restores
+    from the host pool), then OFF (stateless least-loaded placement: a
+    comeback lands wherever occupancy is lowest and usually re-prefills
+    its whole history). Decision numbers per phase: p50 client TTFT and
+    re-prefill-avoided tokens summed over the fleet — what prefix-
+    affinity routing is worth at fleet scale."""
+    import threading
+    from dataclasses import replace as dc_replace
+
+    from opsagent_tpu.serving.api import ServingStack
+    from opsagent_tpu.serving.engine import Engine
+    from opsagent_tpu.serving.fleet.router import FleetRouter
+
+    n_replicas = int(os.environ.get("OPSAGENT_BENCH_REPLICAS", "2"))
+    gen_tokens = max(16, steps // 8)
+    rounds = 3
+    engines = [eng]
+    for i in range(1, n_replicas):
+        e = Engine(dc_replace(cfg, seed=cfg.seed))
+        e.warmup("sessions")
+        engines.append(e)
+    stacks = [ServingStack(e) for e in engines]
+
+    def drive(router, seed_base: int) -> dict:
+        results: list[dict] = []
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def session(sid: int) -> None:
+            rng = np.random.default_rng(seed_base + sid)
+            words = [
+                f"w{rng.integers(0, 9999)}" for _ in range(prompt_len // 2)
+            ]
+            messages = [
+                {"role": "system", "content": "fleet bench"},
+                {"role": "user", "content": " ".join(words)},
+            ]
+            owner = None
+            for r in range(rounds):
+                if r and owner is not None:
+                    # Tool window: the session's replica parks its KV to
+                    # the host tier; the comeback restores ONLY if the
+                    # router sends the turn back to that replica.
+                    info = router.registry.get(owner)
+                    if info is not None and info.handle is not None:
+                        try:
+                            info.handle.park_tokens(
+                                info.handle.tokenize(
+                                    {"messages": messages}
+                                )
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
+                t0 = time.perf_counter()
+                try:
+                    gen = router.complete_stream({
+                        "messages": messages,
+                        "max_tokens": gen_tokens,
+                        "temperature": 0.0,
+                        "stream": True,
+                    })
+                    first = next(gen)
+                    if "error" in first:
+                        raise RuntimeError(first["error"]["message"])
+                    ttft = time.perf_counter() - t0
+                    owner = router.owner_of(first.get("id", "")) or owner
+                    parts: list[str] = []
+                    n_tok = 0
+                    for ch in gen:
+                        if "error" in ch:
+                            raise RuntimeError(ch["error"]["message"])
+                        delta = ch["choices"][0]["delta"]
+                        if delta.get("content"):
+                            parts.append(delta["content"])
+                            n_tok += 1
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"round {r + 1}: {e}")
+                    return
+                messages.append(
+                    {"role": "assistant", "content": "".join(parts)}
+                )
+                messages.append(
+                    {"role": "user", "content": f"continue {r}"}
+                )
+                with lock:
+                    results.append({"ttft": ttft, "tokens": n_tok})
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=session, args=(i,))
+            for i in range(batch)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return {
+            "produced": sum(r["tokens"] for r in results),
+            "wall": time.perf_counter() - t0,
+            "ttfts": [r["ttft"] for r in results],
+            "errors": errors,
+        }
+
+    def fleet_avoided() -> int:
+        return sum(
+            e.offload.restored_tokens for e in engines
+            if e.offload is not None
+        )
+
+    phases: dict[str, dict] = {}
+    for tag, flag, seed in (("affinity", True, 11000), ("off", False, 15000)):
+        router = FleetRouter(
+            affinity=flag, sticky=flag,
+            placement="affinity" if flag else "round_robin",
+        )
+        for i, stack in enumerate(stacks):
+            router.add_local(stack, f"bench-r{i}")
+        avoided0 = fleet_avoided()
+        phases[tag] = drive(router, seed)
+        r = phases[tag]
+        r["p50_ttft_ms"] = (
+            float(np.median(r["ttfts"]) * 1e3) if r["ttfts"] else 0.0
+        )
+        r["reprefill_avoided_tokens"] = fleet_avoided() - avoided0
+        r["tok_s_chip"] = r["produced"] / max(1e-9, r["wall"]) / n_chips
+        log(f"bench[fleet-affinity/{tag}]: {batch} sessions x {rounds} "
+            f"rounds over {n_replicas} replicas, {r['produced']} tokens "
+            f"in {r['wall']:.2f}s -> {r['tok_s_chip']:.0f} tok/s/chip; "
+            f"p50 TTFT {r['p50_ttft_ms']:.0f} ms; re-prefill avoided "
+            f"{r['reprefill_avoided_tokens']} tok; "
+            f"errors={len(r['errors'])}")
+    on, off = phases["affinity"], phases["off"]
+    snap = metrics_snapshot()
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": (
+            f"fleet_affinity[{model}{qtag},N={batch},R={n_replicas},"
+            f"{platform}]"
+        ),
+        "value": round(on["tok_s_chip"], 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": vs_baseline(on["tok_s_chip"], model, platform),
+        "extra": {
+            "replicas": n_replicas,
+            "sessions": batch,
+            "rounds": rounds,
+            "p50_ttft_ms": round(on["p50_ttft_ms"], 1),
+            "reprefill_avoided_tokens": on["reprefill_avoided_tokens"],
+            "off_tok_s_chip": round(off["tok_s_chip"], 1),
+            "off_p50_ttft_ms": round(off["p50_ttft_ms"], 1),
+            "off_reprefill_avoided_tokens": off[
+                "reprefill_avoided_tokens"
+            ],
+            "ttft_delta_ms": round(
+                off["p50_ttft_ms"] - on["p50_ttft_ms"], 1
+            ),
+            "route_decisions": {
+                k[len("opsagent_fleet_route_decisions_total"):] or "total": v
+                for k, v in snap.items()
+                if k.startswith("opsagent_fleet_route_decisions_total")
+            },
+            "kv_transfer_pages": snap.get(
+                "opsagent_fleet_kv_transfer_pages_total", 0
+            ),
+            "errors": len(on["errors"]) + len(off["errors"]),
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
+            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": snap,
+            "attribution": attribution_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    log_perf_table()
+    for s in stacks:
+        s.close()
     exit_if_slo_breach(slo_verdicts())
 
 

@@ -101,7 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
              "BENCH_r*_local.jsonl baseline with noise tolerances "
              "(CI gate: exit 0 pass, 1 regression, 2 nothing comparable)",
     )
-    pc.add_argument("current", help="fresh bench jsonl (result lines)")
+    pc.add_argument(
+        "current",
+        help="fresh bench jsonl (result lines), or a fleet router URL "
+             "(http://...: live rows from GET /api/fleet/bench)",
+    )
     pc.add_argument(
         "--baseline", default="",
         help="baseline jsonl (default: newest committed BENCH_r*_local.jsonl)",
@@ -210,6 +214,70 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         help="capture jax.profiler device traces into this directory "
              "(also enables device.* per-step timings in /api/perf/stats)",
+    )
+    se.add_argument(
+        "--join-fleet", default="",
+        help="fleet router base URL (opsagent serve-router): register "
+             "this replica, heartbeat load + prefix digests, accept "
+             "routed traffic and KV-page transfers",
+    )
+    se.add_argument(
+        "--advertise", default="",
+        help="URL the router should reach this replica at "
+             "(default: http://<host>:<port>)",
+    )
+    se.add_argument(
+        "--replica-id", default="",
+        help="stable replica identity in the fleet (default: random)",
+    )
+    se.add_argument(
+        "--replica-role", default="decode", choices=("decode", "prefill"),
+        help="decode replicas serve sessions end-to-end; prefill "
+             "replicas take the router's long cold admissions and hand "
+             "their KV to a decode replica over the transfer path",
+    )
+
+    sr = sub.add_parser(
+        "serve-router",
+        help="run the fleet router: spreads sessions over N engine "
+             "replicas with prefix-affinity + least-loaded placement, "
+             "sticky pinning, KV-page session migration, and graceful "
+             "drain (serving/fleet)",
+    )
+    sr.add_argument("--port", type=int, default=8090)
+    sr.add_argument("--host", default="0.0.0.0")
+    sr.add_argument(
+        "--tokenizer", default="",
+        help="HF tokenizer path for affinity scoring — MUST match the "
+             "replicas' tokenizer (else scores silently zero and "
+             "placement degrades to least-loaded); default: the "
+             "hermetic byte tokenizer",
+    )
+    sr.add_argument(
+        "--model-name", default="",
+        help="model family for chat-template rendering in affinity "
+             "scoring (matches the replicas' --model-name)",
+    )
+    sr.add_argument(
+        "--no-affinity", action="store_true", default=False,
+        help="disable prefix-affinity scoring (least-loaded only; the "
+             "bench fleet-affinity stage's OFF phase)",
+    )
+    sr.add_argument(
+        "--queue-spill", type=int, default=None,
+        help="queue depth past which a pinned/affinity replica spills "
+             "the route to the rest of the fleet (default: the "
+             "replica's registered capacity)",
+    )
+    sr.add_argument(
+        "--prefill-threshold", type=int, default=256,
+        help="prompt tokens at which a cold admission goes to a "
+             "role=prefill replica first (when one is registered)",
+    )
+    sr.add_argument(
+        "--heartbeat-ttl", type=float, default=None,
+        help="seconds without a heartbeat before a replica is reaped "
+             "(default 10, or OPSAGENT_FLEET_HEARTBEAT_TTL_S)",
     )
 
     return p
@@ -355,6 +423,27 @@ def main(argv: list[str] | None = None) -> int:
             speculative_k=args.speculative_k,
             offload=args.offload,
             async_depth=args.async_depth,
+            join_fleet=args.join_fleet,
+            advertise=args.advertise,
+            replica_id=args.replica_id,
+            replica_role=args.replica_role,
+        )
+        return 0
+
+    if args.command == "serve-router":
+        # The router never builds an engine — only a tokenizer for
+        # affinity scoring and the HTTP/registry plumbing.
+        from ..serving.fleet.router import run_router_server
+
+        run_router_server(
+            host=args.host,
+            port=args.port,
+            tokenizer=args.tokenizer,
+            model_name=args.model_name,
+            affinity=not args.no_affinity,
+            queue_spill=args.queue_spill,
+            prefill_threshold=args.prefill_threshold,
+            heartbeat_ttl_s=args.heartbeat_ttl,
         )
         return 0
 

@@ -68,6 +68,27 @@ def load_rows(path: str) -> list[dict[str, Any]]:
     return rows
 
 
+def fetch_rows(url: str, timeout_s: float = 10.0) -> list[dict[str, Any]]:
+    """Live result rows from a running fleet router: GET
+    /api/fleet/bench returns bench-line-shaped rows assembled from every
+    replica's current SLO values, so the same compare() that gates bench
+    jsonl files gates a running fleet. A bare router base URL gets the
+    path appended; a URL already naming a path is fetched as-is."""
+    from urllib.request import urlopen
+
+    base = url.rstrip("/")
+    if not base.endswith("/api/fleet/bench"):
+        base += "/api/fleet/bench"
+    with urlopen(base, timeout=timeout_s) as resp:  # noqa: S310 - operator URL
+        data = json.loads(resp.read().decode("utf-8"))
+    if not isinstance(data, list):
+        return []
+    return [
+        d for d in data
+        if isinstance(d, dict) and "metric" in d and "value" in d
+    ]
+
+
 def default_baseline() -> str | None:
     """The newest committed BENCH_r*_local.jsonl in the repo root."""
     paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*_local.jsonl")))
@@ -223,7 +244,8 @@ def run_perf_check(
     if not baseline or not os.path.exists(baseline):
         print("perf-check: no baseline jsonl found", file=sys.stderr)
         return 2
-    if not current or not os.path.exists(current):
+    from_url = current.startswith(("http://", "https://"))
+    if not from_url and (not current or not os.path.exists(current)):
         print(f"perf-check: current file not found: {current!r}",
               file=sys.stderr)
         return 2
@@ -235,8 +257,16 @@ def run_perf_check(
         except (OSError, ValueError, AttributeError) as e:
             print(f"perf-check: bad --tolerances file: {e}", file=sys.stderr)
             return 2
+    if from_url:
+        try:
+            current_rows = fetch_rows(current)
+        except Exception as e:  # noqa: BLE001 - CI gate: report, exit 2
+            print(f"perf-check: fleet unreachable: {e}", file=sys.stderr)
+            return 2
+    else:
+        current_rows = load_rows(current)
     report = compare(
-        load_rows(current), load_rows(baseline),
+        current_rows, load_rows(baseline),
         tolerance=tolerance, per_metric=per_metric,
     )
     print(f"perf-check: current={current} baseline={baseline}")
@@ -256,7 +286,12 @@ def main(argv: list[str]) -> int:
         description="compare a fresh bench jsonl against the committed "
                     "baseline; exit 1 on regression",
     )
-    p.add_argument("current", help="fresh bench jsonl (result lines)")
+    p.add_argument(
+        "current",
+        help="fresh bench jsonl (result lines), or a running fleet "
+             "router's base URL (http://...: live rows are fetched from "
+             "GET /api/fleet/bench)",
+    )
     p.add_argument(
         "--baseline", default="",
         help="baseline jsonl (default: newest BENCH_r*_local.jsonl)",

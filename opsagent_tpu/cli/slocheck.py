@@ -4,7 +4,9 @@ a bench/CI gate.
 Three sources, checked in this order:
 
 - ``--url http://host:port`` — fetch ``GET /api/slo`` from a running
-  server (agent server or serving engine; the endpoint is public);
+  server (agent server, serving engine, or the fleet router — the
+  router aggregates every replica's verdicts with replica-prefixed
+  names, so one breached replica breaches the fleet check);
 - ``--bench BENCH.json`` — read the ``extra.slo`` verdicts bench.py folds
   into its result line (accepts a single JSON object or a JSONL file —
   the last line carrying ``extra.slo`` wins);
@@ -96,6 +98,12 @@ def run_slo_check(url: str = "", bench: str = "") -> int:
     except Exception as e:  # noqa: BLE001 - CI gate: report, exit 2
         print(f"slo-check: unavailable: {e}", file=sys.stderr)
         return 2
+    fleet = verdicts.get("fleet")
+    if fleet:
+        print(
+            f"slo-check: fleet rollup over {fleet.get('replicas', 0)} "
+            f"replica(s)"
+        )
     print(_format(verdicts))
     slos = verdicts.get("slos", [])
     if not slos or all(v.get("pass") is None for v in slos):

@@ -224,6 +224,61 @@ HOST_POOL_DROPS = _reg.counter(
     "Host-pool pages LRU-dropped under the byte bound",
 )
 
+# -- fleet serving: replica router + session migration (serving/fleet) --------
+FLEET_REPLICAS = _reg.gauge(
+    "opsagent_fleet_replicas",
+    "Registered engine replicas by role (decode/prefill) and state "
+    "(active/draining)",
+    labelnames=("role", "state"),
+)
+FLEET_ROUTE_DECISIONS = _reg.counter(
+    "opsagent_fleet_route_decisions_total",
+    "Router placement decisions by winning policy (pinned = sticky "
+    "session->replica map, affinity = longest-cached-prefix over the "
+    "replica trie digests, least_loaded = goodput/queue fallback, "
+    "spill = pinned/affinity replica over its queue bound, forced = "
+    "operator/test override, prefill = disaggregated prefill lane)",
+    labelnames=("policy",),
+)
+FLEET_AFFINITY_PAGES = _reg.histogram(
+    "opsagent_fleet_affinity_hit_pages",
+    "Cached-prefix pages the chosen replica already held for the routed "
+    "prompt (the re-prefill the placement avoided, in pages)",
+    buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128),
+)
+FLEET_MIGRATIONS = _reg.counter(
+    "opsagent_fleet_session_migrations_total",
+    "Session migrations over the KV-page transfer path, by reason "
+    "(misroute = affinity miss onto a replica without the pages, "
+    "drain = graceful replica drain, prefill_handoff = disaggregated "
+    "prefill lane -> decode replica)",
+    labelnames=("reason",),
+)
+FLEET_TRANSFER_PAGES = _reg.counter(
+    "opsagent_fleet_kv_transfer_pages_total",
+    "KV pages shipped replica-to-replica (host-pool chain entries)",
+)
+FLEET_TRANSFER_BYTES = _reg.counter(
+    "opsagent_fleet_kv_transfer_bytes_total",
+    "Bytes of KV page payload shipped replica-to-replica",
+)
+FLEET_TRANSFER_SECONDS = _reg.histogram(
+    "opsagent_fleet_kv_transfer_seconds",
+    "Wall time of one replica-to-replica chain transfer (export + import)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5),
+)
+FLEET_SPILLOVERS = _reg.counter(
+    "opsagent_fleet_queue_spillovers_total",
+    "Routes bounced off a preferred replica because its queue depth "
+    "exceeded the spill bound",
+)
+FLEET_REQUESTS = _reg.counter(
+    "opsagent_fleet_requests_total",
+    "Requests routed through the fleet front-end by outcome",
+    labelnames=("outcome",),
+)
+
 # -- request lifecycle --------------------------------------------------------
 ENGINE_REQUESTS = _reg.counter(
     "opsagent_engine_requests_total",

@@ -688,7 +688,10 @@ register_provider("tpu", _tpu_provider_factory)
 
 
 # -- HTTP server -------------------------------------------------------------
-def build_engine_app(stack: ServingStack):
+def build_engine_app(stack: ServingStack, membership=None):
+    """``membership`` is the replica's fleet membership (serve-engine
+    --join-fleet; serving/fleet/client.py): it feeds the /healthz
+    ``fleet`` block and the /fleet/drain notification state."""
     from aiohttp import web
 
     async def models(request: web.Request) -> web.Response:
@@ -707,11 +710,16 @@ def build_engine_app(stack: ServingStack):
 
     async def healthz(request: web.Request) -> web.Response:
         eng = stack.engine
+        sched = getattr(stack, "scheduler", None)
         body = {
             "status": "ok",
             "model": stack.model_name,
             "free_pages": eng.alloc.free_pages,
             "running": len(eng.sequences),
+            # Scheduler queue depth: the fleet router's spill-over input.
+            "queued": len(getattr(sched, "_waiting", ()))
+            + (sched._queue.qsize() if hasattr(sched, "_queue") else 0),
+            "prefilling": len(getattr(sched, "_prefilling", ())),
             "prefix_hit_tokens": eng.alloc.hit_tokens,
             "prefix_miss_tokens": eng.alloc.miss_tokens,
             "prefix_evictions": eng.alloc.evictions,
@@ -723,6 +731,8 @@ def build_engine_app(stack: ServingStack):
                 "depth": eng.cfg.async_depth,
                 "inflight": eng.async_pending(),
             }
+        if membership is not None:
+            body["fleet"] = membership.healthz_block()
         return web.json_response(body)
 
     async def completions(request: web.Request) -> web.StreamResponse:
@@ -946,7 +956,106 @@ def build_engine_app(stack: ServingStack):
             "status": "captured", "seconds": seconds, "logdir": logdir,
         })
 
-    app = web.Application()
+    # -- fleet data plane (serving/fleet): prefix digests for affinity
+    # routing, chain park/export/import for replica-to-replica session
+    # migration, and the drain notification. The wire format is the host
+    # pool's token-chain keying (offload/pool.py), so imported pages
+    # restore through the exact local offload-hit path.
+    async def fleet_digests(request: web.Request) -> web.Response:
+        eng = stack.engine
+        loop = asyncio.get_running_loop()
+        digests = await loop.run_in_executor(None, eng.prefix_digests)
+        return web.json_response({
+            "model": stack.model_name,
+            "page_size": int(eng.cfg.page_size),
+            "digests": digests,
+        })
+
+    async def fleet_park(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            tokens = [int(t) for t in body.get("tokens") or []]
+        except (json.JSONDecodeError, TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "tokens must be an int list"}},
+                status=400,
+            )
+        eng = stack.engine
+        loop = asyncio.get_running_loop()
+
+        def _park() -> int:
+            n = eng.park_chain(tokens)
+            eng.offload_flush()
+            return n
+
+        parked = await loop.run_in_executor(None, _park)
+        return web.json_response({"parked_tokens": parked})
+
+    async def fleet_kv_export(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            tokens = [int(t) for t in body.get("tokens") or []]
+        except (json.JSONDecodeError, TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "tokens must be an int list"}},
+                status=400,
+            )
+        park = bool(body.get("park", True))
+        eng = stack.engine
+        if getattr(eng, "offload", None) is None:
+            return web.json_response({"pages": [], "offload": False})
+        from .fleet.transfer import pack_entries
+
+        loop = asyncio.get_running_loop()
+
+        def _export():
+            if park:
+                eng.park_chain(tokens)
+            eng.offload_flush()
+            return pack_entries(eng.offload.pool.entries_for(tokens))
+
+        pages = await loop.run_in_executor(None, _export)
+        return web.json_response({
+            "pages": pages, "page_size": int(eng.cfg.page_size),
+        })
+
+    async def fleet_kv_import(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            records = body.get("pages") or []
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400
+            )
+        eng = stack.engine
+        if getattr(eng, "offload", None) is None:
+            return web.json_response({"imported": 0, "offload": False})
+        from .fleet.transfer import unpack_entries
+
+        loop = asyncio.get_running_loop()
+
+        def _import() -> int:
+            n = 0
+            for toks, tree in unpack_entries(records, eng.cache):
+                if eng.offload.pool.put(toks, tree):
+                    n += 1
+            return n
+
+        imported = await loop.run_in_executor(None, _import)
+        return web.json_response({"imported": imported})
+
+    async def fleet_drain(request: web.Request) -> web.Response:
+        # Router-initiated graceful drain notification: flips the
+        # /healthz fleet block to draining. Admission gating is the
+        # router's job (it stops routing here); in-flight work finishes.
+        if membership is not None:
+            membership.draining = True
+        return web.json_response({
+            "status": "draining",
+            "running": len(stack.engine.sequences),
+        })
+
+    app = web.Application(client_max_size=256 * 1024 * 1024)
     app.router.add_post("/v1/chat/completions", completions)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/healthz", healthz)
@@ -959,6 +1068,11 @@ def build_engine_app(stack: ServingStack):
     app.router.add_post("/api/debug/profile", profile_capture)
     app.router.add_post("/v1/profile/start", profile_start)
     app.router.add_post("/v1/profile/stop", profile_stop)
+    app.router.add_get("/fleet/digests", fleet_digests)
+    app.router.add_post("/fleet/park", fleet_park)
+    app.router.add_post("/fleet/kv/export", fleet_kv_export)
+    app.router.add_post("/fleet/kv/import", fleet_kv_import)
+    app.router.add_post("/fleet/drain", fleet_drain)
     return app
 
 
@@ -977,6 +1091,10 @@ def run_engine_server(
     speculative_k: int = 0,
     offload: bool = False,
     async_depth: int = 2,
+    join_fleet: str = "",
+    advertise: str = "",
+    replica_id: str = "",
+    replica_role: str = "decode",
 ) -> None:
     from aiohttp import web
 
@@ -1011,7 +1129,18 @@ def run_engine_server(
     engine = Engine(cfg, model_cfg=model_cfg)
     stack = ServingStack(engine)
     install_stack(model_name, stack)
-    app = build_engine_app(stack)
+    membership = None
+    if join_fleet:
+        from .fleet.client import FleetMembership
+
+        membership = FleetMembership(
+            stack,
+            router_url=join_fleet,
+            advertise_url=advertise or f"http://{host}:{port}",
+            replica_id=replica_id,
+            role=replica_role,
+        )
+    app = build_engine_app(stack, membership=membership)
     # Continuous SLO evaluation (GET /api/slo serves the same watchdog):
     # keeps the throughput rate window warm and logs breach transitions
     # into the flight ring even when nobody scrapes.
@@ -1019,6 +1148,10 @@ def run_engine_server(
 
     async def _announce(_) -> None:
         log.info("serving engine listening on %s:%d (model=%s)", host, port, model_name)
+        if membership is not None:
+            # Join AFTER the socket is bound: the router may probe the
+            # advertised URL the moment the registration lands.
+            membership.start()
 
     app.on_startup.append(_announce)
     web.run_app(app, host=host, port=port, print=None)

@@ -2684,6 +2684,24 @@ class Engine:
         with self.lock:
             return self.offload.flush()
 
+    def prefix_digests(self, cap: int = 8192) -> list[str]:
+        """Compact prefix digest of this replica's cached state for the
+        fleet registry: hex chain keys (offload/pool.chain_key_hex) of
+        every HBM-trie-resident page chain plus every host-pool page. The
+        router scores a prompt's longest-cached-prefix affinity against
+        this set; ``cap`` bounds the advertisement (newest trie content
+        wins by iteration order — over-cap replicas just under-advertise,
+        which only costs affinity hits, never correctness)."""
+        from .offload.pool import chain_key_hex
+
+        with self.lock:
+            keys = [chain_key_hex(c) for c in self.alloc.trie_chains()]
+        if self.offload is not None:
+            keys.extend(self.offload.pool.digests())
+        if len(keys) > cap:
+            keys = keys[-cap:]
+        return keys
+
     def park_chain(self, token_ids: list[int]) -> int:
         """Tool-time parking: free the HBM pages holding this token
         history's KV (the session's trie-resident state) after copying

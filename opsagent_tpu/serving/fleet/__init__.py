@@ -1,0 +1,26 @@
+"""Fleet serving: replica registry, prefix-affinity router, and session
+migration over KV-page transfer (ROADMAP item 3 — the architecture step
+from "a fast engine" to "heavy traffic from millions of users").
+
+Modules:
+
+- ``registry``  — replica self-registration (capacity, mesh shape, role,
+  health, prefix-trie digest), heartbeat + liveness reaping, drain state.
+- ``router``    — the front-end: speaks the engine-server API, places
+  sessions by prefix-affinity first / least-loaded-goodput second with
+  sticky pinning, bounded queue spill-over, and graceful replica drain.
+- ``transfer``  — replica-to-replica KV-page shipping using the host
+  pool's token-chain keys as the wire format, so the receiving engine
+  restores-instead-of-reprefills exactly like a local offload hit.
+- ``client``    — the replica-side membership client (``serve-engine
+  --join-fleet``): register, heartbeat, drain state for /healthz.
+"""
+
+from .registry import ReplicaInfo, ReplicaRegistry  # noqa: F401
+from .router import (  # noqa: F401
+    FleetRouter,
+    LocalReplica,
+    build_router_app,
+    run_router_server,
+)
+from .transfer import migrate_chain, pack_entries, unpack_entries  # noqa: F401

@@ -1,0 +1,157 @@
+"""Replica-side fleet membership: ``serve-engine --join-fleet <router>``.
+
+Registers the engine replica with the router, then heartbeats on a
+background thread — each beat refreshing the replica's load snapshot and
+prefix digest so the router's affinity scores track what the trie/host
+pool actually hold. A 410 from the heartbeat endpoint (reaped, or the
+router restarted) triggers transparent re-registration. The membership
+state also feeds the engine server's ``/healthz`` ``fleet`` block
+(replica id, role, registered-router URL, drain state).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+import uuid
+from typing import Any
+
+from ...utils.logger import get_logger
+
+log = get_logger("fleet.client")
+
+DEFAULT_HEARTBEAT_INTERVAL_S = 3.0
+
+
+class FleetMembership:
+    def __init__(
+        self,
+        stack: Any,
+        router_url: str,
+        advertise_url: str,
+        replica_id: str = "",
+        role: str = "decode",
+        heartbeat_interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S,
+    ):
+        self.stack = stack
+        self.router_url = router_url.rstrip("/")
+        self.advertise_url = advertise_url.rstrip("/")
+        self.replica_id = replica_id or f"replica-{uuid.uuid4().hex[:8]}"
+        self.role = role
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.registered = False
+        self.draining = False
+        self.last_heartbeat_ok: bool | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- wire ----------------------------------------------------------------
+    def _post(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
+        req = urllib.request.Request(
+            self.router_url + path,
+            data=json.dumps(body).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(  # noqa: S310 - operator URL
+            req, timeout=10.0
+        ) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def _payload(self, full: bool) -> dict[str, Any]:
+        eng = self.stack.engine
+        sched = self.stack.scheduler
+        body: dict[str, Any] = {
+            "replica_id": self.replica_id,
+            "load": {
+                "running": len(sched._running),
+                "queued": len(sched._waiting) + sched._queue.qsize(),
+                "prefilling": len(sched._prefilling),
+                "free_pages": eng.alloc.free_pages,
+            },
+            "digests": eng.prefix_digests(),
+        }
+        if full:
+            body.update({
+                "url": self.advertise_url,
+                "model": self.stack.model_name,
+                "role": self.role,
+                "capacity": int(eng.cfg.max_batch_size),
+                "page_size": int(eng.cfg.page_size),
+                "mesh": {
+                    "tp": eng.cfg.tp, "sp": eng.cfg.sp, "ep": eng.cfg.ep,
+                },
+            })
+        return body
+
+    # -- lifecycle -----------------------------------------------------------
+    def register(self) -> bool:
+        try:
+            self._post("/fleet/register", self._payload(full=True))
+        except Exception as e:  # noqa: BLE001 - router may not be up yet
+            log.warning("fleet registration failed (will retry): %s", e)
+            self.registered = False
+            return False
+        self.registered = True
+        log.info(
+            "joined fleet at %s as %s (role=%s)",
+            self.router_url, self.replica_id, self.role,
+        )
+        return True
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.register()
+        self._thread = threading.Thread(
+            target=self._beat_loop, daemon=True, name="fleet-heartbeat"
+        )
+        self._thread.start()
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            if self.draining:
+                # Drained (POST /fleet/drain): the router deregistered
+                # this replica on purpose — re-registering would undo the
+                # drain. In-flight work finishes; the process is expected
+                # to exit (or an operator clears .draining to rejoin).
+                continue
+            if not self.registered:
+                self.register()
+                continue
+            try:
+                self._post("/fleet/heartbeat", self._payload(full=False))
+                self.last_heartbeat_ok = True
+            except urllib.error.HTTPError as e:
+                self.last_heartbeat_ok = False
+                if e.code == 410:
+                    # Reaped / router restarted: re-register next beat.
+                    self.registered = False
+            except Exception:  # noqa: BLE001 - router briefly unreachable
+                self.last_heartbeat_ok = False
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if deregister and self.registered:
+            try:
+                self._post(
+                    "/fleet/deregister", {"replica_id": self.replica_id}
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            self.registered = False
+
+    # -- /healthz fleet block -------------------------------------------------
+    def healthz_block(self) -> dict[str, Any]:
+        return {
+            "replica_id": self.replica_id,
+            "role": self.role,
+            "router_url": self.router_url,
+            "registered": self.registered,
+            "draining": self.draining,
+            "last_heartbeat_ok": self.last_heartbeat_ok,
+        }

@@ -1,0 +1,274 @@
+"""Replica registry: the control-plane state the fleet router places
+against.
+
+Engine replicas self-register with capacity, mesh shape, role
+(decode/prefill), page geometry, and a compact prefix-trie digest (hex
+chain keys of their HBM-trie + host-pool resident page chains —
+Engine.prefix_digests). Registrations stay alive through heartbeats that
+refresh load + digests; a replica that stops heartbeating past the
+liveness TTL is reaped (its pinned sessions re-route by affinity on their
+next turn — the transferred/cached pages are gone, so they re-prefill,
+which is correct and merely slow). In-process replicas (LocalReplica)
+mark themselves ``local`` and are polled live instead of push-heartbeated.
+
+Affinity scoring: ``prompt_chain_keys`` digests a prompt's page-aligned
+prefixes with the SAME chain-key function the host pool and HBM trie
+advertisement use, and ``affinity_pages`` counts consecutive leading keys
+a replica holds — longest-cached-prefix wins, in pages.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ... import obs
+from ...utils.logger import get_logger
+from ..offload.pool import chain_key_hex
+
+log = get_logger("fleet.registry")
+
+ENV_HEARTBEAT_TTL = "OPSAGENT_FLEET_HEARTBEAT_TTL_S"
+DEFAULT_HEARTBEAT_TTL_S = 10.0
+
+
+def heartbeat_ttl_s(override: float | None = None) -> float:
+    if override is not None and override > 0:
+        return float(override)
+    try:
+        v = float(os.environ.get(ENV_HEARTBEAT_TTL, ""))
+        if v > 0:
+            return v
+    except ValueError:
+        pass
+    return DEFAULT_HEARTBEAT_TTL_S
+
+
+def prompt_chain_keys(token_ids: list[int], page_size: int) -> list[str]:
+    """Hex chain keys of every page-aligned prefix of ``token_ids[:-1]``
+    (minus the last token, mirroring admission's match_prefix: at least
+    one tail token always prefills to produce next-token logits)."""
+    if page_size <= 0 or len(token_ids) < 2:
+        return []
+    usable = token_ids[: len(token_ids) - 1]
+    return [
+        chain_key_hex(usable[: (i + 1) * page_size])
+        for i in range(len(usable) // page_size)
+    ]
+
+
+@dataclass
+class ReplicaInfo:
+    replica_id: str
+    model: str = ""
+    url: str = ""                  # "" for in-process handles
+    role: str = "decode"           # "decode" | "prefill"
+    capacity: int = 8              # max concurrent sessions (batch size)
+    page_size: int = 64
+    mesh: dict[str, int] = field(default_factory=dict)   # tp/sp/ep shape
+    digests: set[str] = field(default_factory=set)
+    load: dict[str, Any] = field(default_factory=dict)
+    draining: bool = False
+    local: bool = False            # polled live; heartbeat TTL waived
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    handle: Any = None             # ReplicaHandle (router.py)
+
+    def affinity_pages(self, keys: list[str]) -> int:
+        """Consecutive leading prompt chain keys this replica holds —
+        the longest-cached-prefix score, in pages."""
+        n = 0
+        for k in keys:
+            if k not in self.digests:
+                break
+            n += 1
+        return n
+
+    def queue_depth(self) -> int:
+        return int(self.load.get("queued", 0)) + int(
+            self.load.get("prefilling", 0)
+        )
+
+    def load_score(self) -> float:
+        """Least-loaded ordering key: running+queued sessions normalized
+        by capacity, with the goodput fraction (decode-active share of
+        recent wall time, from the replica's attribution snapshot) as a
+        tiebreak — a replica whose wall clock is mostly queued/tool-
+        blocked time scores as busier than its occupancy suggests."""
+        occupied = int(self.load.get("running", 0)) + self.queue_depth()
+        score = occupied / max(1, self.capacity)
+        gp = self.load.get("goodput", {})
+        try:
+            busy = float(gp.get("queued", 0.0))
+            active = float(gp.get("decode_active", 0.0))
+            if busy + active > 0:
+                score += busy / (busy + active) * 0.5
+        except (TypeError, ValueError, AttributeError):
+            pass
+        return score
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "id": self.replica_id,
+            "model": self.model,
+            "url": self.url,
+            "role": self.role,
+            "capacity": self.capacity,
+            "page_size": self.page_size,
+            "mesh": dict(self.mesh),
+            "state": "draining" if self.draining else "active",
+            "local": self.local,
+            "digest_count": len(self.digests),
+            "load": dict(self.load),
+            "heartbeat_age_s": round(
+                time.monotonic() - self.last_heartbeat, 3
+            ),
+        }
+
+
+class ReplicaRegistry:
+    def __init__(self, ttl_s: float | None = None):
+        self.ttl_s = heartbeat_ttl_s(ttl_s)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, ReplicaInfo] = {}
+        self.reaped = 0
+
+    # -- membership --------------------------------------------------------
+    def register(self, info: ReplicaInfo) -> None:
+        with self._lock:
+            info.last_heartbeat = time.monotonic()
+            self._replicas[info.replica_id] = info
+        log.info(
+            "replica %s registered (role=%s model=%s url=%s capacity=%d "
+            "digests=%d)", info.replica_id, info.role, info.model,
+            info.url or "<in-process>", info.capacity, len(info.digests),
+        )
+        self._observe()
+
+    def heartbeat(
+        self,
+        replica_id: str,
+        load: dict[str, Any] | None = None,
+        digests: list[str] | None = None,
+    ) -> bool:
+        """Refresh liveness (+ optionally load/digests). Returns False
+        for unknown ids — the replica should re-register (it was reaped
+        or the router restarted)."""
+        with self._lock:
+            info = self._replicas.get(replica_id)
+            if info is None:
+                return False
+            info.last_heartbeat = time.monotonic()
+            if load is not None:
+                info.load = dict(load)
+            if digests is not None:
+                info.digests = set(digests)
+        return True
+
+    def deregister(self, replica_id: str) -> bool:
+        with self._lock:
+            gone = self._replicas.pop(replica_id, None)
+        if gone is not None:
+            log.info("replica %s deregistered", replica_id)
+            self._observe()
+        return gone is not None
+
+    def set_draining(self, replica_id: str, draining: bool = True) -> bool:
+        with self._lock:
+            info = self._replicas.get(replica_id)
+            if info is None:
+                return False
+            info.draining = draining
+        self._observe()
+        return True
+
+    # -- reads -------------------------------------------------------------
+    def get(self, replica_id: str) -> ReplicaInfo | None:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def reap(self) -> list[str]:
+        """Drop replicas whose heartbeat is stale past the TTL (local
+        handles are polled live and never reaped). Returns reaped ids."""
+        now = time.monotonic()
+        dead: list[str] = []
+        with self._lock:
+            for rid, info in list(self._replicas.items()):
+                if info.local:
+                    continue
+                if now - info.last_heartbeat > self.ttl_s:
+                    dead.append(rid)
+                    del self._replicas[rid]
+        for rid in dead:
+            self.reaped += 1
+            log.warning(
+                "replica %s reaped (no heartbeat for > %.1fs)",
+                rid, self.ttl_s,
+            )
+            obs.flight.record("replica_reaped", replica=rid)
+        if dead:
+            self._observe()
+        return dead
+
+    def refresh_local(self) -> None:
+        """Poll in-process handles for live load + digests (their
+        heartbeat equivalent; cheap — engine-lock reads only)."""
+        with self._lock:
+            locals_ = [i for i in self._replicas.values() if i.local]
+        for info in locals_:
+            if info.handle is None:
+                continue
+            try:
+                info.load = info.handle.load_snapshot()
+                info.digests = set(info.handle.prefix_digests())
+                info.last_heartbeat = time.monotonic()
+            except Exception:  # noqa: BLE001 - a dying local replica
+                log.exception(
+                    "local replica %s poll failed", info.replica_id
+                )
+
+    def alive(
+        self, role: str | None = None, admitting: bool = True
+    ) -> list[ReplicaInfo]:
+        """Live replicas, optionally filtered by role; ``admitting``
+        excludes draining replicas (they finish/migrate, never admit)."""
+        self.reap()
+        now = time.monotonic()
+        with self._lock:
+            out = []
+            for info in self._replicas.values():
+                if role is not None and info.role != role:
+                    continue
+                if admitting and info.draining:
+                    continue
+                if not info.local and (
+                    now - info.last_heartbeat > self.ttl_s
+                ):
+                    continue
+                out.append(info)
+            return out
+
+    def all(self) -> list[ReplicaInfo]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "replicas": [i.snapshot() for i in self.all()],
+            "heartbeat_ttl_s": self.ttl_s,
+            "reaped_total": self.reaped,
+        }
+
+    def _observe(self) -> None:
+        counts: dict[tuple[str, str], int] = {}
+        for info in self.all():
+            key = (info.role, "draining" if info.draining else "active")
+            counts[key] = counts.get(key, 0) + 1
+        for role in ("decode", "prefill"):
+            for state in ("active", "draining"):
+                obs.FLEET_REPLICAS.set(
+                    float(counts.get((role, state), 0)),
+                    role=role, state=state,
+                )

@@ -1,0 +1,1040 @@
+"""The fleet router: a thin front-end that speaks the engine-server API
+and spreads sessions over N engine replicas.
+
+Placement policy, in order:
+
+1. **Sticky pinning** — a session (keyed by its opening messages, or an
+   explicit ``session_id``/``user`` field) stays on the replica that
+   served its first turn, as long as that replica is alive, admitting,
+   and under its queue spill bound.
+2. **Prefix affinity** — otherwise the replica whose advertised prefix
+   digest covers the longest page-aligned prefix of the prompt wins
+   (its trie/host pool already holds the KV; the follow-up turn skips
+   the re-prefill entirely).
+3. **Least-loaded goodput** — no cached state anywhere: the replica with
+   the lowest occupancy/goodput load score takes the session.
+
+Bounded queue spill-over bounces a route off an over-deep preferred
+replica; when the session's pages live on the replica it is bounced FROM,
+the router ships them over the KV-page transfer path first
+(serving/fleet/transfer.py) so the receiving engine restores instead of
+re-prefilling. Graceful drain parks a replica's running sessions,
+migrates them (pages + salvaged tokens) to the rest of the fleet with
+zero request errors, and deregisters the replica.
+
+Disaggregated prefill lanes: replicas registered ``role=prefill`` take
+long cold admissions (prompt >= the prefill threshold with no useful
+affinity anywhere), run exactly one token, and their prefill KV flows to
+the chosen decode replica through the same transfer path.
+
+Two replica flavors behind one handle interface: ``LocalReplica`` wraps
+an in-process ServingStack (tests, the fleet bench stage, co-hosted
+fleets), ``HttpReplica`` a remote engine server (``serve-engine
+--join-fleet``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any
+
+from ... import obs
+from ...utils.logger import get_logger
+from ..scheduler import RequestError
+from .registry import ReplicaInfo, ReplicaRegistry, prompt_chain_keys
+from .transfer import migrate_chain, pack_entries, unpack_entries
+
+log = get_logger("fleet.router")
+
+DEFAULT_PREFILL_THRESHOLD = 256   # prompt tokens; env/CLI overridable
+
+
+# -- replica handles ----------------------------------------------------------
+class LocalReplica:
+    """In-process replica handle over a ServingStack (serving/api.py)."""
+
+    def __init__(self, stack: Any, replica_id: str):
+        self.stack = stack
+        self.replica_id = replica_id
+
+    # routing data plane
+    def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
+        return self.stack.chat_completion(body)
+
+    def chat_completion_stream(self, body: dict[str, Any]):
+        return self.stack.chat_completion_stream(body)
+
+    def tokenize(self, body: dict[str, Any]) -> list[int]:
+        from ..chat_template import apply_chat_template
+
+        tools = (
+            None if body.get("tool_choice") == "none" else body.get("tools")
+        )
+        return apply_chat_template(
+            self.stack.engine.tokenizer, body.get("messages", []),
+            model_family=self.stack.model_name, tools=tools,
+        )
+
+    # registry feeds
+    def info(self) -> ReplicaInfo:
+        eng = self.stack.engine
+        return ReplicaInfo(
+            replica_id=self.replica_id,
+            model=self.stack.model_name,
+            role="decode",
+            capacity=int(eng.cfg.max_batch_size),
+            page_size=int(eng.cfg.page_size),
+            mesh={"tp": eng.cfg.tp, "sp": eng.cfg.sp, "ep": eng.cfg.ep},
+            digests=set(self.prefix_digests()),
+            load=self.load_snapshot(),
+            local=True,
+            handle=self,
+        )
+
+    def load_snapshot(self) -> dict[str, Any]:
+        eng = self.stack.engine
+        sched = self.stack.scheduler
+        return {
+            "running": len(sched._running),
+            "queued": len(sched._waiting) + sched._queue.qsize(),
+            "prefilling": len(sched._prefilling),
+            "free_pages": eng.alloc.free_pages,
+            "goodput": {},
+        }
+
+    def prefix_digests(self) -> list[str]:
+        return self.stack.engine.prefix_digests()
+
+    # KV transfer plane
+    def park_tokens(self, token_ids: list[int]) -> int:
+        eng = self.stack.engine
+        parked = eng.park_chain(token_ids)
+        eng.offload_flush()
+        return parked
+
+    def export_pages(
+        self, token_ids: list[int], park: bool = True
+    ) -> list[dict[str, Any]]:
+        eng = self.stack.engine
+        if eng.offload is None:
+            return []
+        if park:
+            self.park_tokens(token_ids)
+        else:
+            eng.offload_flush()
+        return pack_entries(eng.offload.pool.entries_for(token_ids))
+
+    def import_pages(self, records: list[dict[str, Any]]) -> int:
+        eng = self.stack.engine
+        if eng.offload is None:
+            return 0
+        n = 0
+        for tokens, tree in unpack_entries(records, eng.cache):
+            if eng.offload.pool.put(tokens, tree):
+                n += 1
+        return n
+
+    # drain plane
+    def drain_sessions(self) -> list[Any]:
+        return self.stack.scheduler.drain_for_migration()
+
+    def submit_request(self, req: Any) -> None:
+        self.stack.scheduler.submit(req)
+
+    # observability plane
+    def slo(self) -> dict[str, Any]:
+        return obs.slo.evaluate()
+
+    def timeline(self, request_id: str) -> dict[str, Any] | None:
+        return obs.timeline.assemble(request_id)
+
+    def close(self) -> None:
+        self.stack.close()
+
+
+class HttpReplica:
+    """Remote replica handle over the engine server's HTTP surface."""
+
+    def __init__(self, url: str, replica_id: str, timeout_s: float = 300.0):
+        self.url = url.rstrip("/")
+        self.replica_id = replica_id
+        self.timeout_s = timeout_s
+
+    def _call(
+        self, path: str, body: dict | None = None,
+        timeout_s: float | None = None,
+    ) -> dict[str, Any]:
+        data = None if body is None else json.dumps(body).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + path, data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(  # noqa: S310 - operator-registered URL
+            req, timeout=timeout_s or self.timeout_s
+        ) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
+        try:
+            return self._call("/v1/chat/completions", body)
+        except urllib.error.HTTPError as e:  # surface the engine's verdict
+            try:
+                payload = json.loads(e.read().decode("utf-8"))
+                msg = payload.get("error", {}).get("message", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            raise RequestError(msg, e.code) from e
+
+    def chat_completion_stream(self, body: dict[str, Any]):
+        """SSE pass-through: yields parsed chunk dicts like the local
+        generator, so the router's stream handler treats both alike."""
+        data = json.dumps(dict(body, stream=True)).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + "/v1/chat/completions", data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(  # noqa: S310
+            req, timeout=self.timeout_s
+        ) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8").strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    return
+                yield json.loads(payload)
+
+    def load_snapshot(self) -> dict[str, Any]:
+        h = self._call("/healthz", timeout_s=5.0)
+        return {
+            "running": h.get("running", 0),
+            "queued": h.get("queued", 0),
+            "prefilling": h.get("prefilling", 0),
+            "free_pages": h.get("free_pages", 0),
+            "goodput": h.get("goodput", {}),
+        }
+
+    def prefix_digests(self) -> list[str]:
+        return self._call("/fleet/digests", timeout_s=10.0).get(
+            "digests", []
+        )
+
+    def park_tokens(self, token_ids: list[int]) -> int:
+        return int(self._call(
+            "/fleet/park", {"tokens": token_ids}, timeout_s=30.0
+        ).get("parked_tokens", 0))
+
+    def export_pages(
+        self, token_ids: list[int], park: bool = True
+    ) -> list[dict[str, Any]]:
+        return self._call(
+            "/fleet/kv/export", {"tokens": token_ids, "park": park},
+            timeout_s=60.0,
+        ).get("pages", [])
+
+    def import_pages(self, records: list[dict[str, Any]]) -> int:
+        return int(self._call(
+            "/fleet/kv/import", {"pages": records}, timeout_s=60.0
+        ).get("imported", 0))
+
+    def drain_sessions(self) -> list[Any]:
+        # Cross-process live-request hand-off would need the router to own
+        # the client connection end-to-end; today an HTTP drain stops
+        # admissions (the replica finishes what it runs) and the parked
+        # idle sessions migrate lazily on their next turn.
+        try:
+            self._call("/fleet/drain", {}, timeout_s=10.0)
+        except Exception:  # noqa: BLE001 - best-effort notification
+            log.exception("drain notification to %s failed", self.url)
+        return []
+
+    def slo(self) -> dict[str, Any]:
+        return self._call("/api/slo", timeout_s=10.0)
+
+    def timeline(self, request_id: str) -> dict[str, Any] | None:
+        try:
+            return self._call(
+                f"/api/timeline/{request_id}", timeout_s=10.0
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+
+# -- routing decisions --------------------------------------------------------
+@dataclass
+class RouteDecision:
+    replica: ReplicaInfo
+    policy: str                 # pinned|affinity|least_loaded|spill|forced
+    affinity_pages: int = 0
+    queue_depth: int = 0
+    migrate_from: str | None = None   # replica id still holding the pages
+    session: str = ""
+
+
+class FleetRouter:
+    """Placement + migration control plane. Thread-safe; the HTTP app
+    drives it from executor threads, tests call it directly."""
+
+    def __init__(
+        self,
+        registry: ReplicaRegistry | None = None,
+        affinity: bool = True,
+        queue_spill: int | None = None,
+        prefill_threshold: int = DEFAULT_PREFILL_THRESHOLD,
+        tokenizer: Any = None,
+        model_family: str = "",
+        sticky: bool = True,
+        placement: str = "affinity",
+    ):
+        """``sticky=False`` disables session->replica pinning (every turn
+        re-places from scratch). ``placement="round_robin"`` replaces the
+        whole policy with a stateless rotation — the bench fleet-affinity
+        stage's OFF phase, and what a cache-oblivious load balancer in
+        front of the same replicas would do. (Least-loaded alone is NOT a
+        fair no-affinity baseline for turn-based sessions: a session's
+        own replica frees a slot the instant its turn ends, so occupancy
+        routes the follow-up straight back home by accident.)"""
+        self.registry = registry or ReplicaRegistry()
+        self.affinity = affinity
+        self.sticky = sticky
+        self.placement = placement
+        self._rr = 0
+        self.queue_spill = queue_spill
+        self.prefill_threshold = prefill_threshold
+        self._tokenizer = tokenizer
+        self._model_family = model_family
+        self._lock = threading.Lock()
+        self._pins: OrderedDict[str, str] = OrderedDict()     # session->rid
+        self._owners: OrderedDict[str, str] = OrderedDict()   # req id->rid
+        self._max_map = 8192
+
+    # -- membership convenience -------------------------------------------
+    def add_local(self, stack: Any, replica_id: str) -> LocalReplica:
+        """Register an in-process ServingStack as a replica."""
+        handle = LocalReplica(stack, replica_id)
+        self.registry.register(handle.info())
+        return handle
+
+    # -- session identity ---------------------------------------------------
+    @staticmethod
+    def session_key(body: dict[str, Any]) -> str:
+        """Stable identity of a conversation: an explicit session_id/user
+        field wins; otherwise the digest of the opening (system + first
+        user) messages — which every follow-up turn of an agent session
+        re-sends verbatim at the head of its history."""
+        explicit = body.get("session_id") or body.get("user")
+        if explicit:
+            return str(explicit)
+        head = []
+        for m in body.get("messages", []):
+            head.append((m.get("role", ""), str(m.get("content", ""))))
+            if m.get("role") == "user":
+                break
+        return hashlib.blake2b(
+            json.dumps(head, ensure_ascii=False).encode("utf-8"),
+            digest_size=12,
+        ).hexdigest()
+
+    def tokenize(self, body: dict[str, Any]) -> list[int] | None:
+        """Prompt token ids for affinity scoring: the router-owned
+        tokenizer when configured (HTTP fleets), else the first live
+        local replica's engine tokenizer (in-process fleets). None
+        disables affinity for this request (least-loaded still routes)."""
+        if self._tokenizer is not None:
+            from ..chat_template import apply_chat_template
+
+            tools = (
+                None if body.get("tool_choice") == "none"
+                else body.get("tools")
+            )
+            return apply_chat_template(
+                self._tokenizer, body.get("messages", []),
+                model_family=self._model_family, tools=tools,
+            )
+        for info in self.registry.alive(admitting=False):
+            if info.local and info.handle is not None:
+                try:
+                    return info.handle.tokenize(body)
+                except Exception:  # noqa: BLE001 - affinity is best-effort
+                    log.exception("local tokenize failed")
+                    return None
+        return None
+
+    # -- placement ----------------------------------------------------------
+    def _spill_bound(self, info: ReplicaInfo) -> int:
+        return self.queue_spill if self.queue_spill is not None \
+            else max(2, info.capacity)
+
+    def route(
+        self,
+        body: dict[str, Any],
+        token_ids: list[int] | None = None,
+        force_replica: str | None = None,
+    ) -> RouteDecision:
+        self.registry.refresh_local()
+        skey = self.session_key(body)
+        candidates = self.registry.alive(role="decode")
+        if not candidates:
+            raise RequestError("no live decode replicas in the fleet", 503)
+        if self.placement == "round_robin" and force_replica is None:
+            with self._lock:
+                pick = candidates[self._rr % len(candidates)]
+                self._rr += 1
+            return RouteDecision(
+                pick, "round_robin",
+                queue_depth=pick.queue_depth(), session=skey,
+            )
+        with self._lock:
+            pinned_id = self._pins.get(skey) if self.sticky else None
+        pinned = next(
+            (c for c in candidates if c.replica_id == pinned_id), None
+        )
+        # Affinity scores (pages of cached prefix per replica).
+        scores: dict[str, int] = {}
+        if self.affinity and token_ids:
+            by_psize: dict[int, list[str]] = {}
+            for c in candidates:
+                keys = by_psize.get(c.page_size)
+                if keys is None:
+                    keys = prompt_chain_keys(token_ids, c.page_size)
+                    by_psize[c.page_size] = keys
+                scores[c.replica_id] = c.affinity_pages(keys)
+
+        def best_of(pool: list[ReplicaInfo]) -> tuple[ReplicaInfo, str, int]:
+            """(replica, policy, affinity_pages) over ``pool``: longest
+            cached prefix wins; ties and score-0 fall to least-loaded."""
+            if scores:
+                top = max(
+                    pool, key=lambda c: (
+                        scores.get(c.replica_id, 0) * c.page_size,
+                        -c.load_score(),
+                    ),
+                )
+                if scores.get(top.replica_id, 0) > 0:
+                    return top, "affinity", scores[top.replica_id]
+            top = min(pool, key=lambda c: c.load_score())
+            return top, "least_loaded", scores.get(top.replica_id, 0)
+
+        def holder_of_pages(exclude: str) -> str | None:
+            """The replica (any state, any role) best holding this
+            prompt's pages, for migrate-from bookkeeping."""
+            best_id, best_score = None, 0
+            for info in self.registry.all():
+                if info.replica_id == exclude:
+                    continue
+                s = scores.get(info.replica_id, 0)
+                if s > best_score:
+                    best_id, best_score = info.replica_id, s
+            if best_id is None and pinned_id and pinned_id != exclude:
+                best_id = pinned_id
+            return best_id
+
+        if force_replica is not None:
+            forced = self.registry.get(force_replica)
+            if forced is None:
+                raise RequestError(
+                    f"unknown replica {force_replica!r}", 404
+                )
+            return RouteDecision(
+                forced, "forced",
+                affinity_pages=scores.get(force_replica, 0),
+                queue_depth=forced.queue_depth(),
+                migrate_from=holder_of_pages(force_replica),
+                session=skey,
+            )
+        if pinned is not None:
+            depth = pinned.queue_depth()
+            if depth < self._spill_bound(pinned):
+                return RouteDecision(
+                    pinned, "pinned",
+                    affinity_pages=scores.get(pinned.replica_id, 0),
+                    queue_depth=depth, session=skey,
+                )
+            # Bounded spill-over: the preferred replica's queue is too
+            # deep; route elsewhere and bring the pages along.
+            obs.FLEET_SPILLOVERS.inc()
+            others = [c for c in candidates if c is not pinned]
+            if others:
+                top, _, pages = best_of(others)
+                mig = (
+                    pinned.replica_id
+                    if scores.get(pinned.replica_id, 0) > pages else None
+                )
+                return RouteDecision(
+                    top, "spill", affinity_pages=pages,
+                    queue_depth=top.queue_depth(),
+                    migrate_from=mig, session=skey,
+                )
+            return RouteDecision(
+                pinned, "pinned",
+                affinity_pages=scores.get(pinned.replica_id, 0),
+                queue_depth=depth, session=skey,
+            )
+        top, policy, pages = best_of(candidates)
+        mig = None
+        if policy == "least_loaded":
+            mig = holder_of_pages(top.replica_id)
+        return RouteDecision(
+            top, policy, affinity_pages=pages,
+            queue_depth=top.queue_depth(), migrate_from=mig, session=skey,
+        )
+
+    def _record_decision(
+        self, d: RouteDecision, request_id: str | None = None
+    ) -> None:
+        obs.FLEET_ROUTE_DECISIONS.inc(policy=d.policy)
+        obs.FLEET_AFFINITY_PAGES.observe(float(d.affinity_pages))
+        obs.flight.record(
+            "route_decision", replica=d.replica.replica_id,
+            policy=d.policy, affinity_pages=d.affinity_pages,
+            affinity_tokens=d.affinity_pages * d.replica.page_size,
+            queue_depth=d.queue_depth, session=d.session,
+            **({"request_id": request_id} if request_id else {}),
+        )
+
+    def _note_ownership(self, d: RouteDecision, resp_id: str | None) -> None:
+        with self._lock:
+            if self.sticky:
+                self._pins[d.session] = d.replica.replica_id
+                self._pins.move_to_end(d.session)
+                while len(self._pins) > self._max_map:
+                    self._pins.popitem(last=False)
+            if resp_id:
+                self._owners[resp_id] = d.replica.replica_id
+                while len(self._owners) > self._max_map:
+                    self._owners.popitem(last=False)
+
+    def _maybe_migrate(
+        self, d: RouteDecision, token_ids: list[int] | None, reason: str
+    ) -> None:
+        if d.migrate_from is None or not token_ids:
+            return
+        src = self.registry.get(d.migrate_from)
+        if src is None or src.handle is None or d.replica.handle is None:
+            return
+        migrate_chain(
+            src.handle, d.replica.handle, token_ids,
+            reason=reason, session=d.session,
+        )
+
+    def _maybe_prefill_lane(
+        self, d: RouteDecision, body: dict[str, Any],
+        token_ids: list[int] | None,
+    ) -> None:
+        """Disaggregated prefill: a long cold admission runs its prefill
+        on a role=prefill replica, whose KV then flows to the chosen
+        decode replica over the transfer path; the decode replica's
+        admission restores the prompt pages instead of prefilling them."""
+        if not token_ids or len(token_ids) < self.prefill_threshold:
+            return
+        covered = d.affinity_pages * d.replica.page_size
+        if covered * 2 >= len(token_ids):
+            return  # warm enough locally; the lane would only add copies
+        lanes = self.registry.alive(role="prefill")
+        if not lanes or d.replica.handle is None:
+            return
+        lane = min(lanes, key=lambda c: c.load_score())
+        if lane.handle is None:
+            return
+        obs.FLEET_ROUTE_DECISIONS.inc(policy="prefill")
+        obs.flight.record(
+            "route_decision", replica=lane.replica_id, policy="prefill",
+            affinity_pages=0, queue_depth=lane.queue_depth(),
+            session=d.session,
+        )
+        try:
+            pre_body = dict(body)
+            pre_body.pop("stream", None)
+            pre_body.pop("n", None)
+            pre_body["max_tokens"] = 1
+            lane.handle.chat_completion(pre_body)
+        except Exception:  # noqa: BLE001 - the lane is an optimization
+            log.exception("prefill lane failed; decode replica prefills")
+            return
+        migrate_chain(
+            lane.handle, d.replica.handle, token_ids,
+            reason="prefill_handoff", session=d.session,
+        )
+
+    # -- request plane -------------------------------------------------------
+    def complete(
+        self, body: dict[str, Any], force_replica: str | None = None
+    ) -> dict[str, Any]:
+        token_ids = self.tokenize(body)
+        d = self.route(body, token_ids, force_replica=force_replica)
+        if d.replica.handle is None:
+            raise RequestError(
+                f"replica {d.replica.replica_id} has no handle", 503
+            )
+        self._maybe_migrate(d, token_ids, reason="misroute")
+        self._maybe_prefill_lane(d, body, token_ids)
+        try:
+            resp = d.replica.handle.chat_completion(body)
+        except Exception:
+            obs.FLEET_REQUESTS.inc(outcome="error")
+            raise
+        rid = resp.get("id") if isinstance(resp, dict) else None
+        self._record_decision(d, request_id=rid)
+        self._note_ownership(d, rid)
+        obs.FLEET_REQUESTS.inc(outcome="completed")
+        if isinstance(resp, dict):
+            resp.setdefault("fleet", {})["replica"] = d.replica.replica_id
+            resp["fleet"]["policy"] = d.policy
+        return resp
+
+    def complete_stream(
+        self, body: dict[str, Any], force_replica: str | None = None
+    ):
+        """Generator of SSE chunk dicts routed to the chosen replica."""
+        token_ids = self.tokenize(body)
+        d = self.route(body, token_ids, force_replica=force_replica)
+        if d.replica.handle is None:
+            raise RequestError(
+                f"replica {d.replica.replica_id} has no handle", 503
+            )
+        self._maybe_migrate(d, token_ids, reason="misroute")
+        self._maybe_prefill_lane(d, body, token_ids)
+        gen = d.replica.handle.chat_completion_stream(body)
+        first = True
+        try:
+            for chunk in gen:
+                if first:
+                    rid = chunk.get("id") if isinstance(chunk, dict) \
+                        else None
+                    self._record_decision(d, request_id=rid)
+                    self._note_ownership(d, rid)
+                    first = False
+                yield chunk
+            obs.FLEET_REQUESTS.inc(outcome="completed")
+        except Exception:
+            obs.FLEET_REQUESTS.inc(outcome="error")
+            raise
+
+    # -- drain ----------------------------------------------------------------
+    def drain(self, replica_id: str) -> dict[str, Any]:
+        """Graceful drain: stop admitting to ``replica_id``, park its
+        running sessions, migrate them (KV pages + salvaged requests) to
+        the rest of the fleet, then deregister it. In-process replicas
+        hand their live Request objects across schedulers, so clients
+        (including streams) never see an error; HTTP replicas stop
+        admitting and migrate lazily (see HttpReplica.drain_sessions)."""
+        info = self.registry.get(replica_id)
+        if info is None:
+            raise RequestError(f"unknown replica {replica_id!r}", 404)
+        self.registry.set_draining(replica_id, True)
+        obs.flight.record("replica_drain", replica=replica_id, phase="enter")
+        moved = 0
+        errors = 0
+        reqs: list[Any] = []
+        if info.handle is not None:
+            try:
+                reqs = info.handle.drain_sessions()
+            except Exception:  # noqa: BLE001
+                log.exception("drain_sessions failed on %s", replica_id)
+                errors += 1
+        targets = [
+            c for c in self.registry.alive(role="decode")
+            if c.replica_id != replica_id and c.handle is not None
+        ]
+        for req in reqs:
+            if not targets:
+                # Nowhere to go: the request stays queued on the drained
+                # replica's scheduler? No — the scheduler is stopped.
+                # Fail it loudly rather than hanging the client forever.
+                req.error = "fleet drain found no target replica"
+                req.done.set()
+                errors += 1
+                continue
+            dst = min(targets, key=lambda c: c.load_score())
+            migrate_chain(
+                info.handle, dst.handle, list(req.prompt_ids),
+                reason="drain", park=False,
+            )
+            dst.handle.submit_request(req)
+            moved += 1
+        with self._lock:
+            stale = [k for k, v in self._pins.items() if v == replica_id]
+            for k in stale:
+                del self._pins[k]
+        self.registry.deregister(replica_id)
+        obs.flight.record(
+            "replica_drain", replica=replica_id, phase="exit",
+            migrated=moved, errors=errors,
+        )
+        return {
+            "replica": replica_id, "migrated_sessions": moved,
+            "errors": errors,
+        }
+
+    # -- observability plane ---------------------------------------------------
+    def owner_of(self, request_id: str) -> str | None:
+        with self._lock:
+            return self._owners.get(request_id)
+
+    def timeline(self, request_id: str) -> dict[str, Any] | None:
+        """Request-id pass-through: forward to the owning replica so
+        ``opsagent timeline`` / GET /api/timeline work through the
+        router instead of 404ing. Unknown owners fall back to asking
+        every live replica (the id may predate a router restart)."""
+        rid = self.owner_of(request_id)
+        candidates = []
+        if rid is not None:
+            info = self.registry.get(rid)
+            if info is not None:
+                candidates.append(info)
+        if not candidates:
+            candidates = self.registry.alive(admitting=False)
+        for info in candidates:
+            if info.handle is None:
+                continue
+            try:
+                tl = info.handle.timeline(request_id)
+            except Exception:  # noqa: BLE001 - try the next replica
+                continue
+            if tl is not None:
+                tl["replica"] = info.replica_id
+                return tl
+        return None
+
+    def slo_aggregate(self) -> dict[str, Any]:
+        """Fleet-wide /api/slo: every replica's verdicts concatenated
+        (names prefixed with the replica id) in the standard shape, so
+        ``opsagent slo-check --url <router>`` gates the whole fleet —
+        one breached replica breaches the fleet."""
+        slos: list[dict[str, Any]] = []
+        replicas = 0
+        for info in self.registry.alive(admitting=False):
+            if info.handle is None:
+                continue
+            try:
+                verdicts = info.handle.slo()
+            except Exception:  # noqa: BLE001 - unreachable replica
+                slos.append({
+                    "name": f"{info.replica_id}:reachable",
+                    "pass": False, "unit": "",
+                })
+                continue
+            replicas += 1
+            for v in verdicts.get("slos", []):
+                v = dict(v)
+                v["name"] = f"{info.replica_id}:{v.get('name', '?')}"
+                slos.append(v)
+        return {"slos": slos, "fleet": {"replicas": replicas}}
+
+    def fleet_snapshot(self) -> dict[str, Any]:
+        """GET /api/fleet: the registry view plus a per-replica SLO
+        rollup and the router's session/ownership footprint."""
+        self.registry.refresh_local()
+        snap = self.registry.snapshot()
+        for row in snap["replicas"]:
+            info = self.registry.get(row["id"])
+            if info is None or info.handle is None:
+                continue
+            try:
+                verdicts = info.handle.slo().get("slos", [])
+                row["slo"] = {
+                    "pass": all(
+                        v.get("pass") is not False for v in verdicts
+                    ),
+                    "slos": verdicts,
+                }
+            except Exception:  # noqa: BLE001
+                row["slo"] = {"pass": None, "error": "unreachable"}
+        with self._lock:
+            snap["pinned_sessions"] = len(self._pins)
+            snap["tracked_requests"] = len(self._owners)
+        return snap
+
+    def bench_rows(self) -> list[dict[str, Any]]:
+        """GET /api/fleet/bench: bench-result-shaped rows assembled from
+        each replica's live SLO values, so ``opsagent perf-check --url
+        <router>`` (and scripts/perf_gate.py) can gate a running fleet
+        with the same machinery that gates bench jsonl files."""
+        rows: list[dict[str, Any]] = []
+        for info in self.registry.alive(admitting=False):
+            if info.handle is None:
+                continue
+            try:
+                verdicts = info.handle.slo().get("slos", [])
+            except Exception:  # noqa: BLE001
+                continue
+            for v in verdicts:
+                val = v.get("value")
+                if val is None:
+                    continue
+                unit = (v.get("unit") or "").lower()
+                if unit not in ("ms", "s", "seconds") and "/s" not in unit:
+                    # perf-check derives better/worse direction from the
+                    # unit; a ratio row (error_rate) would gate backwards.
+                    continue
+                rows.append({
+                    "metric": (
+                        f"fleet_{v.get('name', '?')}[{info.replica_id}]"
+                    ),
+                    "value": val,
+                    "unit": v.get("unit", ""),
+                })
+        return rows
+
+
+# -- HTTP front-end -----------------------------------------------------------
+def build_router_app(router: FleetRouter):
+    """The router's aiohttp application: the engine-server API plus the
+    fleet control endpoints (register/heartbeat/deregister/drain) and the
+    fleet observability rollup."""
+    import asyncio
+
+    from aiohttp import web
+
+    def _exec(fn, *args):
+        return asyncio.get_running_loop().run_in_executor(
+            None, fn, *args
+        )
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400
+            )
+        if not body.get("messages"):
+            return web.json_response(
+                {"error": {"message": "messages is required"}}, status=400
+            )
+        force = request.query.get("replica") or None
+        loop = asyncio.get_running_loop()
+        if body.get("stream"):
+            gen = router.complete_stream(body, force_replica=force)
+            try:
+                first = await loop.run_in_executor(
+                    None, lambda: next(gen, None)
+                )
+            except Exception as e:  # noqa: BLE001
+                status = e.status if isinstance(e, RequestError) else 500
+                return web.json_response(
+                    {"error": {"message": str(e), "type": type(e).__name__}},
+                    status=status,
+                )
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            })
+            await resp.prepare(request)
+            chunk = first
+            try:
+                while chunk is not None:
+                    await resp.write(
+                        b"data: " + json.dumps(chunk).encode("utf-8")
+                        + b"\n\n"
+                    )
+                    chunk = await loop.run_in_executor(
+                        None, lambda: next(gen, None)
+                    )
+            except Exception as e:  # noqa: BLE001 - headers already sent
+                err = {"error": {"message": str(e)}}
+                await resp.write(
+                    b"data: " + json.dumps(err).encode("utf-8") + b"\n\n"
+                )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        try:
+            out = await loop.run_in_executor(
+                None, lambda: router.complete(body, force_replica=force)
+            )
+        except Exception as e:  # noqa: BLE001
+            status = e.status if isinstance(e, RequestError) else 500
+            return web.json_response(
+                {"error": {"message": str(e), "type": type(e).__name__}},
+                status=status,
+            )
+        return web.json_response(out)
+
+    async def models(request: web.Request) -> web.Response:
+        seen = []
+        for info in router.registry.alive(admitting=False):
+            if info.model and info.model not in seen:
+                seen.append(info.model)
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"id": m, "object": "model", "owned_by": "opsagent-fleet"}
+                for m in seen
+            ],
+        })
+
+    async def healthz(request: web.Request) -> web.Response:
+        router.registry.refresh_local()
+        replicas = router.registry.all()
+        return web.json_response({
+            "status": "ok" if any(
+                not r.draining for r in replicas
+            ) else "no_replicas",
+            "role": "router",
+            "replicas": len(replicas),
+            "draining": sum(1 for r in replicas if r.draining),
+            "prefill_lanes": sum(
+                1 for r in replicas if r.role == "prefill"
+            ),
+        })
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(
+            text=obs.metrics_text(), content_type="text/plain",
+            charset="utf-8",
+        )
+
+    async def fleet_get(request: web.Request) -> web.Response:
+        return web.json_response(
+            await _exec(router.fleet_snapshot)
+        )
+
+    async def fleet_bench(request: web.Request) -> web.Response:
+        return web.json_response(await _exec(router.bench_rows))
+
+    async def slo_get(request: web.Request) -> web.Response:
+        return web.json_response(await _exec(router.slo_aggregate))
+
+    async def timeline_get(request: web.Request) -> web.Response:
+        tl = await _exec(
+            router.timeline, request.match_info["request_id"]
+        )
+        if tl is None:
+            return web.json_response(
+                {"error": {"message": "unknown request_id"}}, status=404
+            )
+        return web.json_response(tl)
+
+    async def register(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400
+            )
+        rid = body.get("replica_id") or ""
+        url = body.get("url") or ""
+        if not rid or not url:
+            return web.json_response(
+                {"error": {"message": "replica_id and url are required"}},
+                status=400,
+            )
+        info = ReplicaInfo(
+            replica_id=rid,
+            model=body.get("model", ""),
+            url=url,
+            role=body.get("role", "decode"),
+            capacity=int(body.get("capacity", 8)),
+            page_size=int(body.get("page_size", 64)),
+            mesh=dict(body.get("mesh") or {}),
+            digests=set(body.get("digests") or ()),
+            load=dict(body.get("load") or {}),
+            handle=HttpReplica(url, rid),
+        )
+        router.registry.register(info)
+        return web.json_response({
+            "status": "registered", "replica_id": rid,
+            "heartbeat_ttl_s": router.registry.ttl_s,
+        })
+
+    async def heartbeat(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400
+            )
+        ok = router.registry.heartbeat(
+            body.get("replica_id", ""),
+            load=body.get("load"),
+            digests=body.get("digests"),
+        )
+        if not ok:
+            # 410: the replica was reaped (or the router restarted) — it
+            # should POST /fleet/register again.
+            return web.json_response(
+                {"error": {"message": "unknown replica; re-register"}},
+                status=410,
+            )
+        return web.json_response({"status": "ok"})
+
+    async def deregister(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}
+        router.registry.deregister(body.get("replica_id", ""))
+        return web.json_response({"status": "ok"})
+
+    async def drain(request: web.Request) -> web.Response:
+        rid = request.match_info["replica_id"]
+        try:
+            out = await _exec(router.drain, rid)
+        except RequestError as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=e.status
+            )
+        return web.json_response(out)
+
+    from aiohttp import web as _web
+
+    app = _web.Application(client_max_size=256 * 1024 * 1024)
+    app.router.add_post("/v1/chat/completions", completions)
+    app.router.add_get("/v1/models", models)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/api/slo", slo_get)
+    app.router.add_get("/api/fleet", fleet_get)
+    app.router.add_get("/api/fleet/bench", fleet_bench)
+    app.router.add_get("/api/timeline/{request_id}", timeline_get)
+    app.router.add_post("/fleet/register", register)
+    app.router.add_post("/fleet/heartbeat", heartbeat)
+    app.router.add_post("/fleet/deregister", deregister)
+    app.router.add_post("/fleet/drain/{replica_id}", drain)
+    return app
+
+
+def run_router_server(
+    host: str = "0.0.0.0",
+    port: int = 8090,
+    tokenizer: str = "",
+    model_name: str = "",
+    affinity: bool = True,
+    queue_spill: int | None = None,
+    prefill_threshold: int = DEFAULT_PREFILL_THRESHOLD,
+    heartbeat_ttl_s: float | None = None,
+) -> None:
+    """``opsagent serve-router``: the fleet front-end as a process. The
+    tokenizer (HF path, or the hermetic byte tokenizer by default) must
+    match the replicas' — affinity scores hash token chains, so a
+    mismatched tokenizer silently zeroes every score (placement then
+    degrades to least-loaded, which is correct but cold)."""
+    from aiohttp import web
+
+    from ..tokenizer import load_tokenizer
+
+    router = FleetRouter(
+        registry=ReplicaRegistry(ttl_s=heartbeat_ttl_s),
+        affinity=affinity,
+        queue_spill=queue_spill,
+        prefill_threshold=prefill_threshold,
+        tokenizer=load_tokenizer(tokenizer),
+        model_family=model_name,
+    )
+    app = build_router_app(router)
+
+    async def _announce(_) -> None:
+        log.info("fleet router listening on %s:%d", host, port)
+
+    app.on_startup.append(_announce)
+    web.run_app(app, host=host, port=port, print=None)

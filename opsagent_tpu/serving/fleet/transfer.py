@@ -1,0 +1,144 @@
+"""Replica-to-replica KV-page transfer: the data plane of session
+migration.
+
+The wire format IS the host pool's keying scheme (offload/pool.py): each
+shipped page carries the full page-aligned token chain whose KV it holds
+plus the page's numpy leaves. The receiving side inserts the entries into
+its own HostPagePool under the identical chain keys, so the next admission
+of that history restores-instead-of-reprefills through the EXACT local
+offload-hit path (engine._restore_from_host -> offload/copy.py scatter) —
+migration adds no new restore code, only a second way pages arrive in the
+pool.
+
+Pages serialize as JSON-safe dicts (dtype/shape/base64 payload per pytree
+leaf, flattened in ``jax.tree_util`` order). The receiver rebuilds the
+pytree against its OWN cache treedef — both replicas serve the same model,
+so the structures match; a structure mismatch is detected and the entry
+dropped (a dropped entry only costs a re-prefill, never correctness: the
+pool verifies full token chains on match, so a half-shipped chain can
+never alias another history's KV).
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any
+
+import numpy as np
+
+from ... import obs
+from ...utils.logger import get_logger
+
+log = get_logger("fleet.transfer")
+
+
+def pack_entries(entries: list[Any]) -> list[dict[str, Any]]:
+    """HostPage entries -> JSON-safe transfer records (chain tokens +
+    per-leaf dtype/shape/base64 data)."""
+    import jax
+
+    out: list[dict[str, Any]] = []
+    for e in entries:
+        leaves = jax.tree_util.tree_leaves(e.data)
+        out.append({
+            "tokens": np.asarray(e.tokens, np.int32).tolist(),
+            "leaves": [
+                {
+                    "dtype": str(np.asarray(leaf).dtype),
+                    "shape": list(np.asarray(leaf).shape),
+                    "data": base64.b64encode(
+                        np.ascontiguousarray(leaf).tobytes()
+                    ).decode("ascii"),
+                }
+                for leaf in leaves
+            ],
+        })
+    return out
+
+
+def unpack_entries(
+    records: list[dict[str, Any]], template: Any
+) -> list[tuple[list[int], Any]]:
+    """Transfer records -> [(chain_tokens, page_tree)] rebuilt against
+    ``template``'s pytree structure (any tree with the cache's structure —
+    the engine cache itself works; leaf SHAPES in the template are
+    ignored). Records whose leaf count mismatches the template are
+    dropped with a log line."""
+    import jax
+
+    treedef = jax.tree_util.tree_structure(template)
+    out: list[tuple[list[int], Any]] = []
+    for rec in records:
+        specs = rec.get("leaves") or []
+        if treedef.num_leaves != len(specs):
+            log.warning(
+                "transfer record leaf count %d != local cache structure "
+                "%d; dropping page", len(specs), treedef.num_leaves,
+            )
+            continue
+        leaves = [
+            np.frombuffer(
+                base64.b64decode(s["data"]), dtype=np.dtype(s["dtype"])
+            ).reshape(s["shape"]).copy()
+            for s in specs
+        ]
+        out.append(
+            ([int(t) for t in rec["tokens"]],
+             jax.tree_util.tree_unflatten(treedef, leaves))
+        )
+    return out
+
+
+def records_nbytes(records: list[dict[str, Any]]) -> int:
+    """Decoded payload bytes of a transfer (3/4 of the base64 length)."""
+    return sum(
+        (len(s.get("data", "")) * 3) // 4
+        for rec in records
+        for s in rec.get("leaves", ())
+    )
+
+
+def migrate_chain(
+    src: Any, dst: Any, token_ids: list[int], reason: str,
+    session: str = "", park: bool = True,
+) -> int:
+    """Ship one token chain's KV pages from replica ``src`` to replica
+    ``dst`` (both ReplicaHandle, serving/fleet/router.py). With ``park``
+    the source first evicts the chain from its HBM trie into its host
+    pool (Engine.park_chain) — required when the pages are still
+    trie-resident; already-parked chains export directly. Returns pages
+    shipped (0 on any failure — migration is an optimization layered on
+    a correct re-prefill fallback, so it never raises into routing)."""
+    t0 = time.perf_counter()
+    obs.flight.record(
+        "session_migrate", phase="enter", reason=reason, session=session,
+        src=getattr(src, "replica_id", "?"),
+        dst=getattr(dst, "replica_id", "?"),
+        tokens=len(token_ids),
+    )
+    pages = 0
+    nbytes = 0
+    err = ""
+    try:
+        records = src.export_pages(token_ids, park=park)
+        if records:
+            pages = dst.import_pages(records)
+            nbytes = records_nbytes(records)
+    except Exception as e:  # noqa: BLE001 - re-prefill covers correctness
+        err = str(e)
+        log.exception("chain migration failed (receiver will re-prefill)")
+    dt = time.perf_counter() - t0
+    if pages:
+        obs.FLEET_MIGRATIONS.inc(reason=reason)
+        obs.FLEET_TRANSFER_PAGES.inc(pages)
+        obs.FLEET_TRANSFER_BYTES.inc(nbytes)
+        obs.FLEET_TRANSFER_SECONDS.observe(dt)
+    obs.flight.record(
+        "session_migrate", phase="exit", reason=reason, session=session,
+        src=getattr(src, "replica_id", "?"),
+        dst=getattr(dst, "replica_id", "?"),
+        pages=pages, bytes=nbytes, ms=round(dt * 1e3, 3),
+        **({"error": err} if err else {}),
+    )
+    return pages

@@ -119,6 +119,18 @@ class PageAllocator:
             cur = self._by_page.get(cur.parent)
         return []
 
+    def trie_chains(self) -> list[list[int]]:
+        """Full page-aligned token chain of every trie-resident page (one
+        chain per node, each covering the node and all its ancestors).
+        Feeds the fleet registry's prefix digest: the router scores
+        longest-cached-prefix affinity against these chains' digests."""
+        out: list[list[int]] = []
+        for node in self._by_page.values():
+            chain = self._chain_tokens(node)
+            if chain:
+                out.append(chain)
+        return out
+
     # -- queries -----------------------------------------------------------
     @property
     def free_pages(self) -> int:

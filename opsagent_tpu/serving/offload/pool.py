@@ -54,6 +54,14 @@ def _chain_key(tokens: "np.ndarray") -> bytes:
     ).digest()
 
 
+def chain_key_hex(tokens) -> str:
+    """Public form of the chain digest (hex), shared with the fleet layer:
+    the replica registry advertises these keys as its prefix digest, the
+    router scores prompt affinity against them, and the replica-to-replica
+    KV transfer uses the underlying token chains as its wire format."""
+    return _chain_key(np.asarray(tokens, dtype=np.int32)).hex()
+
+
 def tree_nbytes(data: Any) -> int:
     import jax
 
@@ -187,6 +195,17 @@ class HostPagePool:
         with self._lock:
             self._entries.clear()
             self.used_bytes = 0
+
+    def digests(self) -> list[str]:
+        """Hex chain keys of every resident page (the fleet registry's
+        host-tier half of the replica prefix digest)."""
+        with self._lock:
+            return [k.hex() for k in self._entries]
+
+    def entries_for(self, tokens: list[int] | np.ndarray) -> list[HostPage]:
+        """Alias of :meth:`match` from page 0 — the export side of a
+        replica-to-replica chain transfer (serving/fleet/transfer.py)."""
+        return self.match(tokens, start_page=0)
 
     # -- accounting --------------------------------------------------------
     @property

@@ -112,6 +112,10 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
+        # Graceful-drain mode (serving/fleet): the loop exits WITHOUT
+        # failing in-flight requests — drain_for_migration() then parks
+        # and returns them for re-admission on another replica.
+        self._draining = False
 
     # -- public ------------------------------------------------------------
     def start(self) -> None:
@@ -130,6 +134,81 @@ class Scheduler:
         self._queue.put(req)
         self._wake.set()
         return req
+
+    def drain_for_migration(self) -> list[Request]:
+        """Graceful replica drain (serving/fleet): stop the loop WITHOUT
+        failing anything, park every running session's KV to the host
+        tier, and return every request that still needs tokens so the
+        fleet router can re-admit them on another replica. Token loss is
+        zero by construction: running sequences salvage their generated
+        tokens through ``_requeue_salvaged`` (prompt += salvage, budget -=
+        salvage, FSM/penalty state carried — the slice-restart flow), so
+        the re-admission elsewhere continues exactly where this replica
+        stopped; streaming clients keep their callbacks and are never
+        re-sent a token. Requests that finished while the pipeline
+        settled are reaped normally (their clients see a clean result).
+
+        Engines without the offload tier still drain correctly — the
+        salvage folds into the prompt and the target replica re-prefills
+        it — they just cannot ship KV pages, so the detour costs a
+        re-prefill instead of a page copy."""
+        self._draining = True
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        out: list[Request] = []
+        self._drain_queue()
+        for sid, req in list(self._running.items()):
+            parked = None
+            if getattr(self.engine, "offload", None) is not None:
+                try:
+                    parked = self.engine.park_sequence(sid)
+                except Exception:  # noqa: BLE001 - fall back to salvage
+                    log.exception("drain parking of seq %d failed", sid)
+            if parked is not None:
+                self._running.pop(sid)
+                if self._requeue_salvaged(
+                    req, parked.tokens, parked.logprob_data, parked=True
+                ):
+                    out.append(req)
+                continue
+            seq = self.engine.sequences.get(sid)
+            if seq is not None and seq.done:
+                continue  # reaped below with full results
+            # No offload tier (or parking raced): salvage host state and
+            # re-admit whole; the pages are simply freed.
+            partial: list[int] = []
+            lp: list[dict] = []
+            if seq is not None:
+                lp = list(seq.logprob_data)
+            try:
+                partial = self.engine.finish(sid)
+            except Exception:  # noqa: BLE001 - device state may be gone
+                pass
+            self._running.pop(sid, None)
+            if self._requeue_salvaged(req, partial, lp):
+                out.append(req)
+        self._reap()
+        for sid, req in list(self._prefilling.items()):
+            try:
+                self.engine.abort_request(sid)
+            except Exception:  # noqa: BLE001
+                pass
+            req.seq_id = None
+            req.enqueued_s = time.perf_counter()
+            out.append(req)
+        self._prefilling.clear()
+        out.extend(self._waiting)
+        self._waiting = []
+        flush = getattr(self.engine, "offload_flush", None)
+        if flush is not None:
+            try:
+                flush()  # land the parked pages in the host pool
+            except Exception:  # noqa: BLE001 - best-effort
+                pass
+        return out
 
     def complete(
         self,
@@ -726,6 +805,16 @@ class Scheduler:
                     req.done.set()
                 self._running.clear()
                 consecutive_failures = 0
+        if self._draining:
+            # Graceful drain: leave every in-flight request intact for
+            # drain_for_migration() to park and hand to the fleet router.
+            log.info(
+                "scheduler loop stopped for drain (%d running, %d "
+                "prefilling, %d waiting retained)",
+                len(self._running), len(self._prefilling),
+                len(self._waiting),
+            )
+            return
         # drain on shutdown
         for req in self._waiting:
             req.error = "scheduler stopped"

@@ -191,6 +191,16 @@ def terminal_summary(paths: list[str]) -> int:
             f"{e.get('off_admission_wait_p50_ms', 0)} ms (off); "
             f"re-prefill avoided {e.get('reprefill_avoided_tokens', 0)} tok"
         )
+    fleet = [d for d in tpu if d["metric"].startswith("fleet_affinity")]
+    if fleet:
+        e = fleet[-1].get("extra", {})
+        print(
+            f"fleet A/B ({e.get('replicas', '?')} replicas): p50 TTFT "
+            f"{e.get('p50_ttft_ms', 0)} ms (affinity) vs "
+            f"{e.get('off_p50_ttft_ms', 0)} ms (round-robin); "
+            f"re-prefill avoided {e.get('reprefill_avoided_tokens', 0)} "
+            f"vs {e.get('off_reprefill_avoided_tokens', 0)} tok"
+        )
     agent = [d for d in tpu if d["metric"].startswith("agent_turn_ttft")]
     if agent:
         best_a = min(agent, key=lambda d: d["value"])

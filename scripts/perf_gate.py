@@ -6,6 +6,8 @@ tolerances; exit 1 on regression, 2 when nothing is comparable.
     python scripts/perf_gate.py tpu_results_r06/bench.jsonl
     python scripts/perf_gate.py fresh.jsonl --baseline BENCH_r04_local.jsonl \
         --tolerance 0.15
+    python scripts/perf_gate.py http://router:8090   # live fleet rows
+                                                     # (GET /api/fleet/bench)
 
 Thin shim over ``opsagent_tpu.cli.perfcheck`` (also reachable as
 ``opsagent perf-check``) so CI can call the gate without installing the

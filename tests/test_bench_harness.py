@@ -253,3 +253,41 @@ def test_sessions_offload_mode_reports_ab_decision_numbers():
     assert e["reprefill_avoided_tokens"] > 0
     assert e["off_reprefill_avoided_tokens"] == 0
     assert e["restored_tokens"] > 0
+
+
+def test_fleet_affinity_mode_reports_ab_numbers():
+    """OPSAGENT_BENCH_MODE=fleet-affinity (the tier-1-safe fast-lane form
+    of the fleet A/B stage: CPU, tiny model, 2 in-process replicas behind
+    the FleetRouter) must run the sessions workload with prefix-affinity
+    + sticky placement and with stateless round-robin placement, and emit
+    BOTH phases' p50 TTFT and re-prefill-avoided token counts in ONE
+    JSON line — the decision numbers prefix-affinity routing exists for.
+    The affinity phase restores every parked comeback on its owning
+    replica; the round-robin phase mis-routes some comebacks, so it can
+    never avoid more re-prefill than affinity does."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "fleet-affinity",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("fleet_affinity[")
+    assert parsed["unit"] == "tok/s/chip"
+    e = parsed["extra"]
+    assert e["errors"] == 0
+    assert e["replicas"] == 2
+    # Both phases measured and distinguishable.
+    assert e["p50_ttft_ms"] > 0 and e["off_p50_ttft_ms"] > 0
+    assert "ttft_delta_ms" in e
+    # The affinity phase actually restored parked sessions on their
+    # owning replicas; stateless placement cannot beat it.
+    assert e["reprefill_avoided_tokens"] > 0
+    assert e["off_reprefill_avoided_tokens"] <= \
+        e["reprefill_avoided_tokens"]
+    # The router's placement telemetry rode along.
+    assert any("pinned" in k for k in e["route_decisions"])
+    assert any("round_robin" in k for k in e["route_decisions"])
