@@ -57,6 +57,11 @@ OPSAGENT_BENCH_REPLICAS (default 2) in-process engine replicas behind
 the fleet router, twice — prefix-affinity + sticky placement on, then
 stateless least-loaded — reporting p50 TTFT and re-prefill-avoided
 tokens for both phases in one JSON line.
+OPSAGENT_BENCH_MODE=fleet-chaos runs that fleet workload twice more —
+seeded faults off, then on (serving/faults: mid-SSE disconnects at
+fixed hit counts) — reporting failed requests (must stay 0: router
+failover absorbs the deaths), failovers, shed count, and the p99 TTFT
+delta containment costs, in one JSON line.
 ``--perf-gate`` (or OPSAGENT_BENCH_PERF_GATE=1) compares the
 orchestrated run's result lines against the committed
 BENCH_r*_local.jsonl baseline after the headline is printed and exits 4
@@ -331,6 +336,9 @@ def run_orchestrated() -> None:
         "OPSAGENT_BENCH_KV": None,
         "OPSAGENT_BENCH_MIXED": None,
         "OPSAGENT_BENCH_ASYNC": None,
+        # An operator-exported fault spec must never contaminate the
+        # perf stages; the fleet-chaos stage pins its own spec in-process.
+        "OPSAGENT_FAULTS": None,
     }
 
     def stage(env_extra: dict, min_remaining: float, tag: str,
@@ -457,6 +465,15 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         240, "fleet-affinity",
     ) if on_tpu else None
+    # Failure-containment A/B: the same fleet workload with seeded faults
+    # OFF then ON (mid-SSE disconnects + connect failures). The chaos
+    # phase must complete with ZERO failed requests — failovers absorb
+    # the injected deaths; what it costs is the reported p99 TTFT delta.
+    rchaos = stage(
+        {"OPSAGENT_BENCH_MODE": "fleet-chaos",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        230, "fleet-chaos",
+    ) if on_tpu else None
     # The literal north-star metric (BASELINE: p50 TTFT per tool-call
     # turn): multi-turn ReAct-shaped sessions with the prefix cache on.
     # Reports ms, not tok/s — never a headline candidate; folded into
@@ -572,6 +589,16 @@ def run_orchestrated() -> None:
         extra["fleet_off_reprefill_avoided_tokens"] = fe.get(
             "off_reprefill_avoided_tokens"
         )
+    if rchaos is not None:
+        che = rchaos.get("extra", {})
+        extra["fleet_chaos_failed_requests"] = che.get("failed_requests")
+        extra["fleet_chaos_failovers"] = che.get("failovers")
+        extra["fleet_chaos_shed"] = che.get("shed")
+        extra["fleet_chaos_p99_ttft_ms"] = che.get("p99_ttft_ms")
+        extra["fleet_chaos_off_p99_ttft_ms"] = che.get("off_p99_ttft_ms")
+        extra["fleet_chaos_outputs_identical"] = che.get(
+            "outputs_identical"
+        )
     if ragent is not None:
         ae = ragent.get("extra", {})
         extra["agent_turn_p50_ttft_ms"] = ragent["value"]
@@ -597,7 +624,7 @@ def run_orchestrated() -> None:
     # printed, so the verdict can never eat a result line.
     exit_if_perf_regression([
         r1, r8b, r8b4, r8bkv, r8b4kv, rsess, rsessmix, rsessasync,
-        rsessoff, rfleet, ragent, rdma, rdmakv, rcold, rspec,
+        rsessoff, rfleet, rchaos, ragent, rdma, rdmakv, rcold, rspec,
     ])
 
 
@@ -639,7 +666,7 @@ def run_single() -> None:
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
-                "sessions-async", "fleet-affinity"):
+                "sessions-async", "fleet-affinity", "fleet-chaos"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -713,7 +740,8 @@ def run_single() -> None:
         decode_block=decode_block,
         mixed_batching=mixed_on,
         async_depth=async_depth,
-        offload=(mode in ("sessions-offload", "fleet-affinity")),
+        offload=(mode in ("sessions-offload", "fleet-affinity",
+                          "fleet-chaos")),
     )
     # Fail fast on undersized sweep points: OutOfPages mid-window would
     # force-finish sequences ('length') and quietly deflate the metric.
@@ -746,7 +774,7 @@ def run_single() -> None:
     # -> pipelined decode), so it shares that warmup level.
     t0 = time.perf_counter()
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
-                "sessions-async", "fleet-affinity"):
+                "sessions-async", "fleet-affinity", "fleet-chaos"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -775,6 +803,10 @@ def run_single() -> None:
     if mode == "fleet-affinity":
         run_fleet_affinity(eng, cfg, model, batch, steps, prompt_len,
                            platform, n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "fleet-chaos":
+        run_fleet_chaos(eng, cfg, model, batch, steps, prompt_len,
+                        platform, n_chips, quantize, init_s, warmup_s)
         return
     if mode == "agent":
         # turns/gen_tokens are THE values the page-budget guard above was
@@ -1490,6 +1522,176 @@ def run_fleet_affinity(eng, cfg, model, batch, steps, prompt_len, platform,
             "chips": n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": snap,
+            "attribution": attribution_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    log_perf_table()
+    for s in stacks:
+        s.close()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_fleet_chaos(eng, cfg, model, batch, steps, prompt_len, platform,
+                    n_chips, quantize, init_s, warmup_s) -> None:
+    """The fleet-chaos A/B stage (serving/faults + router failover): two
+    in-process engine replicas behind the FleetRouter, the concurrent-
+    sessions streaming workload run TWICE — seeded faults OFF (reference
+    run), then ON (mid-SSE disconnects + connect-phase failures from the
+    deterministic injector). The failure-containment claim measured:
+    the chaos phase finishes with ZERO failed requests (failovers resume
+    every broken stream on the surviving replica, byte-identically under
+    greedy decode); what containment costs is the p99 TTFT delta."""
+    import threading
+    from dataclasses import replace as dc_replace
+
+    from opsagent_tpu.serving import faults
+    from opsagent_tpu.serving.api import ServingStack
+    from opsagent_tpu.serving.engine import Engine
+    from opsagent_tpu.serving.fleet.router import FleetRouter
+
+    n_replicas = int(os.environ.get("OPSAGENT_BENCH_REPLICAS", "2"))
+    gen_tokens = max(16, steps // 8)
+    rounds = 2
+    engines = [eng]
+    for _ in range(1, n_replicas):
+        e = Engine(dc_replace(cfg, seed=cfg.seed))
+        e.warmup("sessions")
+        engines.append(e)
+    stacks = [ServingStack(e) for e in engines]
+    # Default spec: kill stream pulls and a connect at fixed hit counts —
+    # same spec, same workload, same flight-event sequence every run.
+    spec = os.environ.get(
+        "OPSAGENT_BENCH_CHAOS_SPEC",
+        "fleet.stream_disconnect@7;fleet.stream_disconnect@29;"
+        "fleet.stream_disconnect@63",
+    )
+
+    def drive(router, seed_base: int) -> dict:
+        texts: dict[int, list[str]] = {}
+        ttfts: list[float] = []
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def session(sid: int) -> None:
+            rng = np.random.default_rng(seed_base + sid)
+            words = [
+                f"w{rng.integers(0, 9999)}" for _ in range(prompt_len // 2)
+            ]
+            messages = [
+                {"role": "system", "content": "chaos bench"},
+                {"role": "user", "content": " ".join(words)},
+            ]
+            for r in range(rounds):
+                t0 = time.perf_counter()
+                try:
+                    gen = router.complete_stream({
+                        "messages": messages,
+                        "max_tokens": gen_tokens,
+                        "temperature": 0.0,
+                        "stream": True,
+                    })
+                    first = next(gen)
+                    if "error" in first:
+                        raise RuntimeError(first["error"]["message"])
+                    ttft = time.perf_counter() - t0
+                    parts: list[str] = []
+                    for ch in gen:
+                        if "error" in ch:
+                            raise RuntimeError(ch["error"]["message"])
+                        delta = ch["choices"][0]["delta"]
+                        if delta.get("content"):
+                            parts.append(delta["content"])
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"session {sid} round {r + 1}: {e}")
+                    return
+                reply = "".join(parts)
+                messages.append({"role": "assistant", "content": reply})
+                messages.append({"role": "user", "content": f"go {r}"})
+                with lock:
+                    texts.setdefault(sid, []).append(reply)
+                    ttfts.append(ttft)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=session, args=(i,))
+            for i in range(batch)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return {
+            "texts": texts, "ttfts": ttfts, "errors": errors,
+            "wall": time.perf_counter() - t0,
+            "produced": sum(len(t) for ts in texts.values() for t in ts),
+        }
+
+    def counter(snap: dict, name: str) -> float:
+        return sum(v for k, v in snap.items() if k.startswith(name))
+
+    phases: dict[str, dict] = {}
+    for tag, chaotic in (("off", False), ("chaos", True)):
+        router = FleetRouter()
+        for i, stack in enumerate(stacks):
+            router.add_local(stack, f"chaos-r{i}")
+        if chaotic:
+            faults.configure(spec)
+        else:
+            faults.reset()
+        before = metrics_snapshot()
+        phases[tag] = drive(router, seed_base=21000)  # SAME seeds per phase
+        faults.reset()
+        after = metrics_snapshot()
+        r = phases[tag]
+        r["p99_ttft_ms"] = (
+            float(np.percentile(r["ttfts"], 99) * 1e3) if r["ttfts"]
+            else 0.0
+        )
+        for fam, key in (
+            ("opsagent_fleet_failovers_total", "failovers"),
+            ("opsagent_fleet_retries_total", "retries"),
+            ("opsagent_fleet_shed_total", "shed"),
+            ("opsagent_fault_injections_total", "injected"),
+        ):
+            r[key] = int(counter(after, fam) - counter(before, fam))
+        log(f"bench[fleet-chaos/{tag}]: {batch} sessions x {rounds} "
+            f"rounds, {r['produced']} replies in {r['wall']:.2f}s; "
+            f"p99 TTFT {r['p99_ttft_ms']:.0f} ms; injected={r['injected']} "
+            f"failovers={r['failovers']} retries={r['retries']} "
+            f"shed={r['shed']} errors={len(r['errors'])}")
+    off, chaos = phases["off"], phases["chaos"]
+    identical = off["texts"] == chaos["texts"]
+    snap = metrics_snapshot()
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": (
+            f"fleet_chaos[{model}{qtag},N={batch},R={n_replicas},"
+            f"{platform}]"
+        ),
+        "value": len(chaos["errors"]),
+        "unit": "failed_requests",
+        "vs_baseline": None,
+        "extra": {
+            "replicas": n_replicas,
+            "sessions": batch,
+            "rounds": rounds,
+            "spec": spec,
+            "failed_requests": len(chaos["errors"]),
+            "off_failed_requests": len(off["errors"]),
+            "injected": chaos["injected"],
+            "failovers": chaos["failovers"],
+            "retries": chaos["retries"],
+            "shed": chaos["shed"],
+            "p99_ttft_ms": round(chaos["p99_ttft_ms"], 1),
+            "off_p99_ttft_ms": round(off["p99_ttft_ms"], 1),
+            "outputs_identical": identical,
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
             "metrics": snap,
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),

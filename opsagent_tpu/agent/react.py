@@ -30,6 +30,7 @@ from typing import Any
 
 from .. import obs
 from ..llm.client import ChatClient
+from ..serving import faults
 from ..llm.tokens import constrict_messages, constrict_prompt, get_token_limits
 from ..tools import ToolPrompt, get_tools, ToolError
 from ..utils.jsonrepair import extract_field
@@ -228,6 +229,14 @@ def _react_loop(
             try:
                 with ps.timer(f"agent.tool.{name}"), \
                         obs.span("tool_exec", tool=name):
+                    faults.maybe_raise(
+                        "tool.exec", ToolError,
+                        "injected tool subprocess failure", tool=name,
+                    )
+                    faults.maybe_raise(
+                        "tool.timeout", TimeoutError,
+                        "injected tool subprocess timeout", tool=name,
+                    )
                     observation = tools[name](tool_input)
                 obs.TOOL_CALLS.inc(tool=name, outcome="ok")
                 _tool_flight("ok")

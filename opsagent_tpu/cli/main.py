@@ -279,6 +279,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds without a heartbeat before a replica is reaped "
              "(default 10, or OPSAGENT_FLEET_HEARTBEAT_TTL_S)",
     )
+    sr.add_argument(
+        "--max-retries", type=int, default=2,
+        help="connect-phase re-routes per request before the error "
+             "surfaces to the client (failover rides the per-replica "
+             "circuit breaker)",
+    )
+    sr.add_argument(
+        "--hedge-queue-depth", type=int, default=None,
+        help="TTFT hedging: race a duplicate of a queued cold "
+             "non-streaming admission on a second replica once the "
+             "chosen replica's queue is this deep (default: off)",
+    )
+    sr.add_argument(
+        "--shed-queue-depth", type=int, default=None,
+        help="overload shedding: 429 + Retry-After for new admissions "
+             "once EVERY replica's queue is this deep (default: off)",
+    )
 
     return p
 
@@ -444,6 +461,9 @@ def main(argv: list[str] | None = None) -> int:
             queue_spill=args.queue_spill,
             prefill_threshold=args.prefill_threshold,
             heartbeat_ttl_s=args.heartbeat_ttl,
+            max_retries=args.max_retries,
+            hedge_queue_depth=args.hedge_queue_depth,
+            shed_queue_depth=args.shed_queue_depth,
         )
         return 0
 

@@ -97,7 +97,7 @@ def default_baseline() -> str | None:
 
 def _higher_better(unit: str) -> bool:
     u = (unit or "").lower()
-    if u in ("ms", "s", "seconds"):
+    if u in ("ms", "s", "seconds", "failed_requests", "errors"):
         return False
     return True  # tok/s/chip and friends
 

@@ -275,8 +275,53 @@ FLEET_SPILLOVERS = _reg.counter(
 )
 FLEET_REQUESTS = _reg.counter(
     "opsagent_fleet_requests_total",
-    "Requests routed through the fleet front-end by outcome",
+    "Requests routed through the fleet front-end by outcome "
+    "(completed / error / shed)",
     labelnames=("outcome",),
+)
+
+# -- failure containment: fault injection, failover, shedding -----------------
+FAULT_INJECTIONS = _reg.counter(
+    "opsagent_fault_injections_total",
+    "Deterministic fault injections fired, by fault point "
+    "(serving/faults.py; OPSAGENT_FAULTS spec)",
+    labelnames=("point",),
+)
+FLEET_FAILOVERS = _reg.counter(
+    "opsagent_fleet_failovers_total",
+    "Mid-request failovers: a request re-submitted to a surviving "
+    "replica after its serving replica failed (streams resume from the "
+    "last emitted offset, dedup on re-submit)",
+)
+FLEET_RETRIES = _reg.counter(
+    "opsagent_fleet_retries_total",
+    "Bounded connect-phase retries against fleet replicas "
+    "(exponential backoff + jitter)",
+)
+FLEET_HEDGES = _reg.counter(
+    "opsagent_fleet_hedges_total",
+    "TTFT hedges: a queued cold admission raced on a second replica, "
+    "first completion wins",
+)
+FLEET_EJECTIONS = _reg.counter(
+    "opsagent_fleet_ejections_total",
+    "Circuit-breaker ejections (replica health healthy -> suspect -> "
+    "ejected; half-open probes readmit)",
+)
+FLEET_SHED = _reg.counter(
+    "opsagent_fleet_shed_total",
+    "Requests shed by router admission control above the overload "
+    "watermark (429 + Retry-After)",
+)
+FLEET_REPLICA_HEALTH = _reg.gauge(
+    "opsagent_fleet_replica_health",
+    "Registered replicas by circuit-breaker health state",
+    labelnames=("state",),
+)
+FLEET_KV_IMPORT_REJECTS = _reg.counter(
+    "opsagent_fleet_kv_import_rejects_total",
+    "KV transfer records rejected at import (payload digest or "
+    "structure mismatch); the receiver re-prefills instead",
 )
 
 # -- request lifecycle --------------------------------------------------------

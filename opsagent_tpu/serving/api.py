@@ -26,6 +26,7 @@ from .. import obs
 from ..llm.client import register_provider
 from ..utils.jsonrepair import parse_json
 from ..utils.logger import get_logger
+from . import faults
 from .chat_template import apply_chat_template
 from .engine import Engine, EngineConfig
 from .sampler import SamplingParams
@@ -733,6 +734,10 @@ def build_engine_app(stack: ServingStack, membership=None):
             }
         if membership is not None:
             body["fleet"] = membership.healthz_block()
+        if faults.active():
+            # Chaos visibility: which fault points are armed and what has
+            # fired — so an operator can tell injected pain from real pain.
+            body["faults"] = faults.summary()
         return web.json_response(body)
 
     async def completions(request: web.Request) -> web.StreamResponse:

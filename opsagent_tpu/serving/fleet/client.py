@@ -4,25 +4,34 @@ Registers the engine replica with the router, then heartbeats on a
 background thread — each beat refreshing the replica's load snapshot and
 prefix digest so the router's affinity scores track what the trie/host
 pool actually hold. A 410 from the heartbeat endpoint (reaped, or the
-router restarted) triggers transparent re-registration. The membership
-state also feeds the engine server's ``/healthz`` ``fleet`` block
-(replica id, role, registered-router URL, drain state).
+router restarted) triggers transparent re-registration, paced by a
+jittered, capped backoff so a router restart does not get a thundering
+herd of simultaneous re-registers; transport failures (``URLError`` /
+``OSError`` — router blip, DNS, refused socket) never kill the
+membership thread. The membership state also feeds the engine server's
+``/healthz`` ``fleet`` block (replica id, role, registered-router URL,
+drain state).
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
+import time
 import urllib.error
 import urllib.request
 import uuid
 from typing import Any
 
 from ...utils.logger import get_logger
+from .. import faults
 
 log = get_logger("fleet.client")
 
 DEFAULT_HEARTBEAT_INTERVAL_S = 3.0
+REGISTER_BACKOFF_BASE_S = 1.0
+REGISTER_BACKOFF_CAP_S = 30.0
 
 
 class FleetMembership:
@@ -46,6 +55,12 @@ class FleetMembership:
         self.last_heartbeat_ok: bool | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Re-registration pacing: per-replica seeded jitter decorrelates
+        # a fleet's herd after a router restart without making any one
+        # replica's schedule nondeterministic across its own retries.
+        self._jitter = random.Random(self.replica_id)
+        self._register_backoff_s = 0.0
+        self._next_register_s = 0.0
 
     # -- wire ----------------------------------------------------------------
     def _post(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
@@ -92,8 +107,17 @@ class FleetMembership:
         except Exception as e:  # noqa: BLE001 - router may not be up yet
             log.warning("fleet registration failed (will retry): %s", e)
             self.registered = False
+            # Jittered, capped backoff before the next attempt.
+            self._register_backoff_s = min(
+                REGISTER_BACKOFF_CAP_S,
+                (self._register_backoff_s or REGISTER_BACKOFF_BASE_S) * 2,
+            )
+            self._next_register_s = time.monotonic() + \
+                self._register_backoff_s * self._jitter.uniform(0.5, 1.5)
             return False
         self.registered = True
+        self._register_backoff_s = 0.0
+        self._next_register_s = 0.0
         log.info(
             "joined fleet at %s as %s (role=%s)",
             self.router_url, self.replica_id, self.role,
@@ -118,7 +142,14 @@ class FleetMembership:
                 # to exit (or an operator clears .draining to rejoin).
                 continue
             if not self.registered:
-                self.register()
+                if time.monotonic() >= self._next_register_s:
+                    self.register()
+                continue
+            if faults.fire("client.heartbeat_drop", replica=self.replica_id):
+                # Injected: this beat is silently lost in transit. The
+                # router sees a stale heartbeat (suspect past ttl/2,
+                # reaped past ttl); the replica just beats again.
+                self.last_heartbeat_ok = False
                 continue
             try:
                 self._post("/fleet/heartbeat", self._payload(full=False))
@@ -128,6 +159,10 @@ class FleetMembership:
                 if e.code == 410:
                     # Reaped / router restarted: re-register next beat.
                     self.registered = False
+            except (urllib.error.URLError, OSError):
+                # Router blip (refused socket, DNS, reset): the thread
+                # must survive — connectivity usually comes back.
+                self.last_heartbeat_ok = False
             except Exception:  # noqa: BLE001 - router briefly unreachable
                 self.last_heartbeat_ok = False
 

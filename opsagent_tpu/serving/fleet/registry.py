@@ -34,6 +34,12 @@ log = get_logger("fleet.registry")
 ENV_HEARTBEAT_TTL = "OPSAGENT_FLEET_HEARTBEAT_TTL_S"
 DEFAULT_HEARTBEAT_TTL_S = 10.0
 
+ENV_EJECT_COOLDOWN = "OPSAGENT_FLEET_EJECT_COOLDOWN_S"
+DEFAULT_EJECT_COOLDOWN_S = 2.0
+EJECT_COOLDOWN_MAX_S = 30.0
+EJECT_AFTER_FAILURES = 3      # consecutive call failures -> ejected
+PROBE_TIMEOUT_S = 30.0        # a half-open probe that never reports back
+
 
 def heartbeat_ttl_s(override: float | None = None) -> float:
     if override is not None and override > 0:
@@ -45,6 +51,50 @@ def heartbeat_ttl_s(override: float | None = None) -> float:
     except ValueError:
         pass
     return DEFAULT_HEARTBEAT_TTL_S
+
+
+def eject_cooldown_s(override: float | None = None) -> float:
+    if override is not None and override > 0:
+        return float(override)
+    try:
+        v = float(os.environ.get(ENV_EJECT_COOLDOWN, ""))
+        if v > 0:
+            return v
+    except ValueError:
+        pass
+    return DEFAULT_EJECT_COOLDOWN_S
+
+
+@dataclass
+class ReplicaHealth:
+    """Circuit-breaker state for one replica, fed by router call
+    outcomes (``ReplicaRegistry.note_result``) and heartbeat staleness:
+
+        healthy --failure--> suspect --(3 consecutive)--> ejected
+        ejected --cooldown--> half-open probe --success--> healthy
+                                              --failure--> ejected
+                                                (cooldown doubles, capped)
+
+    Ejected replicas are excluded from admitting ``alive()`` reads until
+    the cooldown elapses; then ONE in-flight probe request is admitted
+    (``begin_probe``) and its outcome decides."""
+
+    state: str = "healthy"            # healthy | suspect | ejected
+    consecutive_failures: int = 0
+    ejections: int = 0                # lifetime; drives cooldown backoff
+    ejected_until: float = 0.0        # monotonic deadline
+    probe_started: float = 0.0        # 0 = no half-open probe in flight
+
+    def admitting(self, now: float) -> bool:
+        if self.state != "ejected":
+            return True
+        if now < self.ejected_until:
+            return False
+        # Half-open: admit only while no (live) probe is in flight.
+        return (
+            self.probe_started == 0.0
+            or now - self.probe_started > PROBE_TIMEOUT_S
+        )
 
 
 def prompt_chain_keys(token_ids: list[int], page_size: int) -> list[str]:
@@ -129,10 +179,16 @@ class ReplicaInfo:
 
 
 class ReplicaRegistry:
-    def __init__(self, ttl_s: float | None = None):
+    def __init__(
+        self,
+        ttl_s: float | None = None,
+        eject_cooldown: float | None = None,
+    ):
         self.ttl_s = heartbeat_ttl_s(ttl_s)
+        self.eject_cooldown_s = eject_cooldown_s(eject_cooldown)
         self._lock = threading.Lock()
         self._replicas: dict[str, ReplicaInfo] = {}
+        self._health: dict[str, ReplicaHealth] = {}
         self.reaped = 0
 
     # -- membership --------------------------------------------------------
@@ -140,6 +196,9 @@ class ReplicaRegistry:
         with self._lock:
             info.last_heartbeat = time.monotonic()
             self._replicas[info.replica_id] = info
+            # A (re-)registration is a fresh process (or an operator's
+            # explicit rejoin): start from a clean health slate.
+            self._health[info.replica_id] = ReplicaHealth()
         log.info(
             "replica %s registered (role=%s model=%s url=%s capacity=%d "
             "digests=%d)", info.replica_id, info.role, info.model,
@@ -170,6 +229,7 @@ class ReplicaRegistry:
     def deregister(self, replica_id: str) -> bool:
         with self._lock:
             gone = self._replicas.pop(replica_id, None)
+            self._health.pop(replica_id, None)
         if gone is not None:
             log.info("replica %s deregistered", replica_id)
             self._observe()
@@ -201,6 +261,7 @@ class ReplicaRegistry:
                 if now - info.last_heartbeat > self.ttl_s:
                     dead.append(rid)
                     del self._replicas[rid]
+                    self._health.pop(rid, None)
         for rid in dead:
             self.reaped += 1
             log.warning(
@@ -247,16 +308,97 @@ class ReplicaRegistry:
                     now - info.last_heartbeat > self.ttl_s
                 ):
                     continue
+                # Heartbeat staleness feeds the breaker: a remote replica
+                # past half the TTL is suspect (still routable — the
+                # liveness reap above handles full staleness).
+                health = self._health.get(info.replica_id)
+                if (
+                    health is not None and not info.local
+                    and health.state == "healthy"
+                    and now - info.last_heartbeat > self.ttl_s / 2
+                ):
+                    health.state = "suspect"
+                if admitting and health is not None \
+                        and not health.admitting(now):
+                    continue
                 out.append(info)
             return out
+
+    # -- circuit breaker ---------------------------------------------------
+    def note_result(self, replica_id: str, ok: bool) -> None:
+        """Feed one router call outcome into the replica's health state
+        machine. Successes close the breaker; consecutive failures walk
+        healthy -> suspect -> ejected with exponentially backed-off
+        cooldowns (half-open probes readmit, see ReplicaHealth)."""
+        ejected = False
+        with self._lock:
+            if replica_id not in self._replicas:
+                return
+            health = self._health.setdefault(replica_id, ReplicaHealth())
+            health.probe_started = 0.0
+            if ok:
+                if health.state != "healthy":
+                    log.info("replica %s healthy again", replica_id)
+                health.state = "healthy"
+                health.consecutive_failures = 0
+            else:
+                health.consecutive_failures += 1
+                if health.state == "healthy":
+                    health.state = "suspect"
+                was_open = health.state == "ejected"
+                if health.consecutive_failures >= EJECT_AFTER_FAILURES \
+                        or was_open:
+                    health.state = "ejected"
+                    health.ejections += 1
+                    cooldown = min(
+                        self.eject_cooldown_s
+                        * (2 ** max(0, health.ejections - 1)),
+                        EJECT_COOLDOWN_MAX_S,
+                    )
+                    health.ejected_until = time.monotonic() + cooldown
+                    ejected = True
+        if ejected:
+            log.warning(
+                "replica %s ejected by circuit breaker (%d consecutive "
+                "failures)", replica_id,
+                self._health[replica_id].consecutive_failures,
+            )
+            obs.FLEET_EJECTIONS.inc()
+            obs.flight.record(
+                "replica_ejected", replica=replica_id,
+                failures=self._health[replica_id].consecutive_failures,
+                ejections=self._health[replica_id].ejections,
+            )
+        self._observe()
+
+    def begin_probe(self, replica_id: str) -> None:
+        """Mark the half-open probe in flight: the router calls this when
+        it routes a request onto an ejected-past-cooldown replica, so
+        only one probe is outstanding at a time."""
+        with self._lock:
+            health = self._health.get(replica_id)
+            if health is not None and health.state == "ejected":
+                health.probe_started = time.monotonic()
+
+    def health_of(self, replica_id: str) -> ReplicaHealth | None:
+        with self._lock:
+            return self._health.get(replica_id)
+
+    def health_snapshot(self) -> dict[str, str]:
+        with self._lock:
+            return {rid: h.state for rid, h in self._health.items()}
 
     def all(self) -> list[ReplicaInfo]:
         with self._lock:
             return list(self._replicas.values())
 
     def snapshot(self) -> dict[str, Any]:
+        health = self.health_snapshot()
+        rows = [i.snapshot() for i in self.all()]
+        for row in rows:
+            row["health"] = health.get(row["id"], "healthy")
         return {
-            "replicas": [i.snapshot() for i in self.all()],
+            "replicas": rows,
             "heartbeat_ttl_s": self.ttl_s,
             "reaped_total": self.reaped,
         }
@@ -272,3 +414,10 @@ class ReplicaRegistry:
                     float(counts.get((role, state), 0)),
                     role=role, state=state,
                 )
+        hcounts: dict[str, int] = {}
+        for state in self.health_snapshot().values():
+            hcounts[state] = hcounts.get(state, 0) + 1
+        for state in ("healthy", "suspect", "ejected"):
+            obs.FLEET_REPLICA_HEALTH.set(
+                float(hcounts.get(state, 0)), state=state
+            )

@@ -31,21 +31,37 @@ Two replica flavors behind one handle interface: ``LocalReplica`` wraps
 an in-process ServingStack (tests, the fleet bench stage, co-hosted
 fleets), ``HttpReplica`` a remote engine server (``serve-engine
 --join-fleet``).
+
+Failure containment: every replica call feeds a per-replica circuit
+breaker (healthy → suspect → ejected with half-open probes, see
+registry.ReplicaHealth); connect-phase failures retry on another replica
+with exponential backoff + jitter; a replica dying mid-SSE triggers
+failover that re-submits the request elsewhere and resumes the client
+stream from the last emitted character (greedy decode makes the resumed
+text byte-identical); queued cold admissions can be TTFT-hedged on a
+second replica; and when every replica's queue is past the shed
+watermark, new work gets 429 + Retry-After instead of deepening the
+collapse. Fault points for all of these live in serving/faults.py.
 """
 
 from __future__ import annotations
 
+import copy
 import hashlib
 import json
+import queue as queue_mod
+import random
 import threading
+import time
 import urllib.error
 import urllib.request
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from typing import Any
 
 from ... import obs
 from ...utils.logger import get_logger
+from .. import faults
 from ..scheduler import RequestError
 from .registry import ReplicaInfo, ReplicaRegistry, prompt_chain_keys
 from .transfer import migrate_chain, pack_entries, unpack_entries
@@ -53,6 +69,65 @@ from .transfer import migrate_chain, pack_entries, unpack_entries
 log = get_logger("fleet.router")
 
 DEFAULT_PREFILL_THRESHOLD = 256   # prompt tokens; env/CLI overridable
+DEFAULT_MAX_RETRIES = 2           # connect-phase retries per request
+DEFAULT_MAX_FAILOVERS = 2         # mid-stream re-submissions per request
+RETRY_BACKOFF_BASE_S = 0.05
+RETRY_BACKOFF_CAP_S = 2.0
+
+
+class ReplicaStreamBroken(ConnectionError):
+    """A replica's SSE stream died (disconnect, or an in-band error
+    chunk from a dying engine) before the finish chunk arrived."""
+
+
+class OverloadError(RequestError):
+    """Router admission control shed this request (HTTP 429); carries
+    the Retry-After hint the HTTP front-end surfaces as a header."""
+
+    def __init__(self, message: str, retry_after_s: int = 1):
+        super().__init__(message, 429)
+        self.retry_after_s = retry_after_s
+
+
+def _retryable(e: BaseException) -> bool:
+    """Failures worth re-routing: transport errors and engine-side (5xx)
+    verdicts. Client errors (4xx: bad request, overload shed) would fail
+    identically on every replica."""
+    if isinstance(e, RequestError):
+        return e.status >= 500
+    return isinstance(
+        e, (urllib.error.URLError, ConnectionError, TimeoutError, OSError)
+    )
+
+
+def _chunk_content(chunk: Any) -> str:
+    """Content delta carried by an SSE chunk dict ('' for head/finish)."""
+    if not isinstance(chunk, dict):
+        return ""
+    choices = chunk.get("choices") or []
+    if not choices:
+        return ""
+    return (choices[0].get("delta") or {}).get("content") or ""
+
+
+def _is_head_chunk(chunk: Any) -> bool:
+    """The role-announcement chunk that opens every stream (emitted once
+    per client stream even across failovers)."""
+    if not isinstance(chunk, dict):
+        return False
+    choices = chunk.get("choices") or []
+    if not choices:
+        return False
+    return "role" in (choices[0].get("delta") or {})
+
+
+def _trim_chunk_content(chunk: dict[str, Any], skip: int) -> dict[str, Any]:
+    """Drop the first ``skip`` chars of a chunk's content delta — the
+    failover seam lands mid-chunk and the prefix was already delivered."""
+    out = copy.deepcopy(chunk)
+    delta = out["choices"][0]["delta"]
+    delta["content"] = delta["content"][skip:]
+    return out
 
 
 # -- replica handles ----------------------------------------------------------
@@ -170,6 +245,17 @@ class HttpReplica:
         self, path: str, body: dict | None = None,
         timeout_s: float | None = None,
     ) -> dict[str, Any]:
+        faults.maybe_raise(
+            "fleet.connect",
+            urllib.error.URLError(ConnectionRefusedError(
+                "injected connection refused"
+            )),
+            replica=self.replica_id, path=path,
+        )
+        faults.maybe_raise(
+            "fleet.timeout", TimeoutError, "injected request timeout",
+            replica=self.replica_id, path=path,
+        )
         data = None if body is None else json.dumps(body).encode("utf-8")
         req = urllib.request.Request(
             self.url + path, data=data,
@@ -194,6 +280,13 @@ class HttpReplica:
     def chat_completion_stream(self, body: dict[str, Any]):
         """SSE pass-through: yields parsed chunk dicts like the local
         generator, so the router's stream handler treats both alike."""
+        faults.maybe_raise(
+            "fleet.connect",
+            urllib.error.URLError(ConnectionRefusedError(
+                "injected connection refused"
+            )),
+            replica=self.replica_id, path="/v1/chat/completions",
+        )
         data = json.dumps(dict(body, stream=True)).encode("utf-8")
         req = urllib.request.Request(
             self.url + "/v1/chat/completions", data=data,
@@ -294,6 +387,10 @@ class FleetRouter:
         model_family: str = "",
         sticky: bool = True,
         placement: str = "affinity",
+        max_retries: int = DEFAULT_MAX_RETRIES,
+        max_failovers: int = DEFAULT_MAX_FAILOVERS,
+        hedge_queue_depth: int | None = None,
+        shed_queue_depth: int | None = None,
     ):
         """``sticky=False`` disables session->replica pinning (every turn
         re-places from scratch). ``placement="round_robin"`` replaces the
@@ -302,7 +399,15 @@ class FleetRouter:
         front of the same replicas would do. (Least-loaded alone is NOT a
         fair no-affinity baseline for turn-based sessions: a session's
         own replica frees a slot the instant its turn ends, so occupancy
-        routes the follow-up straight back home by accident.)"""
+        routes the follow-up straight back home by accident.)
+
+        Failure containment knobs: ``max_retries`` bounds connect-phase
+        re-routes per request, ``max_failovers`` bounds mid-stream
+        re-submissions, ``hedge_queue_depth`` (None = off) races a
+        duplicate of a queued cold non-streaming admission on a second
+        replica, ``shed_queue_depth`` (None = off) sheds new admissions
+        with 429 + Retry-After once EVERY live decode replica's queue
+        is at or past the watermark."""
         self.registry = registry or ReplicaRegistry()
         self.affinity = affinity
         self.sticky = sticky
@@ -310,6 +415,10 @@ class FleetRouter:
         self._rr = 0
         self.queue_spill = queue_spill
         self.prefill_threshold = prefill_threshold
+        self.max_retries = max_retries
+        self.max_failovers = max_failovers
+        self.hedge_queue_depth = hedge_queue_depth
+        self.shed_queue_depth = shed_queue_depth
         self._tokenizer = tokenizer
         self._model_family = model_family
         self._lock = threading.Lock()
@@ -379,10 +488,40 @@ class FleetRouter:
         body: dict[str, Any],
         token_ids: list[int] | None = None,
         force_replica: str | None = None,
+        exclude: set[str] | None = None,
+    ) -> RouteDecision:
+        """``exclude`` drops replicas the caller just watched fail (the
+        retry/failover loops); when exclusion would empty the fleet the
+        full candidate set is kept — retrying the only replica beats
+        failing outright. Routing onto an ejected-past-cooldown replica
+        marks its half-open probe (the call outcome closes or re-opens
+        the breaker)."""
+        d = self._route_inner(body, token_ids, force_replica, exclude)
+        health = self.registry.health_of(d.replica.replica_id)
+        if health is not None and health.state == "ejected":
+            self.registry.begin_probe(d.replica.replica_id)
+            obs.flight.record(
+                "replica_probe", replica=d.replica.replica_id,
+                session=d.session,
+            )
+        return d
+
+    def _route_inner(
+        self,
+        body: dict[str, Any],
+        token_ids: list[int] | None = None,
+        force_replica: str | None = None,
+        exclude: set[str] | None = None,
     ) -> RouteDecision:
         self.registry.refresh_local()
         skey = self.session_key(body)
         candidates = self.registry.alive(role="decode")
+        if exclude:
+            kept = [
+                c for c in candidates if c.replica_id not in exclude
+            ]
+            if kept:
+                candidates = kept
         if not candidates:
             raise RequestError("no live decode replicas in the fleet", 503)
         if self.placement == "round_robin" and force_replica is None:
@@ -565,59 +704,266 @@ class FleetRouter:
             reason="prefill_handoff", session=d.session,
         )
 
+    # -- overload shedding ---------------------------------------------------
+    def _check_overload(self, force_replica: str | None) -> None:
+        """Router admission control: once EVERY live decode replica's
+        queue depth is at or past the watermark, new work is shed with
+        429 + Retry-After BEFORE it deepens the queues (backpressure to
+        the client instead of melted replicas). Forced routes (operator
+        overrides, drain tooling) bypass the shed."""
+        if self.shed_queue_depth is None or force_replica is not None:
+            return
+        self.registry.refresh_local()
+        cands = self.registry.alive(role="decode")
+        if not cands:
+            return  # route() raises its own 503
+        depths = [c.queue_depth() for c in cands]
+        if min(depths) < self.shed_queue_depth:
+            return
+        retry_after = int(min(30, max(1, min(depths))))
+        obs.FLEET_SHED.inc()
+        obs.FLEET_REQUESTS.inc(outcome="shed")
+        obs.flight.record(
+            "request_shed", min_queue_depth=min(depths),
+            watermark=self.shed_queue_depth, retry_after_s=retry_after,
+        )
+        raise OverloadError(
+            "fleet overloaded: every replica queue depth >= "
+            f"{self.shed_queue_depth}; retry later", retry_after,
+        )
+
+    @staticmethod
+    def _backoff(attempt: int) -> None:
+        """Exponential backoff + jitter between re-routes. The jitter is
+        real randomness on purpose — it decorrelates retrying clients;
+        fault DECISIONS (faults.py) stay count-based so injected chaos
+        is deterministic even though retry timing is not."""
+        delay = min(
+            RETRY_BACKOFF_CAP_S,
+            RETRY_BACKOFF_BASE_S * (2 ** max(0, attempt - 1)),
+        )
+        time.sleep(delay * random.uniform(0.5, 1.0))
+
+    # -- TTFT hedging --------------------------------------------------------
+    def _pick_hedge_backup(self, d: RouteDecision) -> ReplicaInfo | None:
+        """A queued COLD admission (no cached prefix anywhere to lose)
+        whose chosen replica has ``hedge_queue_depth`` or more requests
+        ahead of it is worth racing on a second replica: greedy decode
+        is deterministic, so the duplicate is pure latency insurance."""
+        if self.hedge_queue_depth is None or d.affinity_pages > 0:
+            return None
+        if d.queue_depth < self.hedge_queue_depth:
+            return None
+        others = [
+            c for c in self.registry.alive(role="decode")
+            if c.replica_id != d.replica.replica_id
+            and c.handle is not None
+        ]
+        if not others:
+            return None
+        return min(others, key=lambda c: c.load_score())
+
+    def _hedged_complete(
+        self, body: dict[str, Any], d: RouteDecision, backup: ReplicaInfo
+    ) -> tuple[RouteDecision, dict[str, Any]]:
+        """Race the admission on primary + backup; first completion wins
+        (the loser's work is discarded — greedy outputs are identical).
+        Each arrival feeds the circuit breaker; the winner's decision is
+        what gets recorded/pinned."""
+        obs.FLEET_HEDGES.inc()
+        obs.flight.record(
+            "fleet_hedge", primary=d.replica.replica_id,
+            backup=backup.replica_id, queue_depth=d.queue_depth,
+            session=d.session,
+        )
+        results: queue_mod.Queue = queue_mod.Queue()
+
+        def _run(info: ReplicaInfo) -> None:
+            try:
+                results.put((info, info.handle.chat_completion(body), None))
+            except Exception as e:  # noqa: BLE001 - raced; judged below
+                results.put((info, None, e))
+
+        for info in (d.replica, backup):
+            threading.Thread(target=_run, args=(info,), daemon=True).start()
+        last_err: Exception | None = None
+        for _ in range(2):
+            info, resp, err = results.get()
+            self.registry.note_result(info.replica_id, ok=err is None)
+            if err is None:
+                if info.replica_id == backup.replica_id:
+                    return dc_replace(
+                        d, replica=backup, policy="hedge",
+                        queue_depth=backup.queue_depth(),
+                    ), resp
+                return d, resp
+            last_err = err
+        raise last_err  # both lost the race
+
     # -- request plane -------------------------------------------------------
     def complete(
         self, body: dict[str, Any], force_replica: str | None = None
     ) -> dict[str, Any]:
         token_ids = self.tokenize(body)
-        d = self.route(body, token_ids, force_replica=force_replica)
-        if d.replica.handle is None:
-            raise RequestError(
-                f"replica {d.replica.replica_id} has no handle", 503
+        self._check_overload(force_replica)
+        excluded: set[str] = set()
+        attempt = 0
+        while True:
+            d = self.route(
+                body, token_ids, force_replica=force_replica,
+                exclude=excluded,
             )
-        self._maybe_migrate(d, token_ids, reason="misroute")
-        self._maybe_prefill_lane(d, body, token_ids)
-        try:
-            resp = d.replica.handle.chat_completion(body)
-        except Exception:
-            obs.FLEET_REQUESTS.inc(outcome="error")
-            raise
-        rid = resp.get("id") if isinstance(resp, dict) else None
-        self._record_decision(d, request_id=rid)
-        self._note_ownership(d, rid)
-        obs.FLEET_REQUESTS.inc(outcome="completed")
-        if isinstance(resp, dict):
-            resp.setdefault("fleet", {})["replica"] = d.replica.replica_id
-            resp["fleet"]["policy"] = d.policy
-        return resp
+            if d.replica.handle is None:
+                raise RequestError(
+                    f"replica {d.replica.replica_id} has no handle", 503
+                )
+            self._maybe_migrate(
+                d, token_ids,
+                reason="failover" if excluded else "misroute",
+            )
+            if not excluded:
+                self._maybe_prefill_lane(d, body, token_ids)
+            backup = self._pick_hedge_backup(d) if not excluded else None
+            rid_name = d.replica.replica_id
+            try:
+                if backup is not None:
+                    d, resp = self._hedged_complete(body, d, backup)
+                else:
+                    resp = d.replica.handle.chat_completion(body)
+                    self.registry.note_result(rid_name, ok=True)
+            except Exception as e:  # noqa: BLE001 - classified below
+                if backup is None:
+                    self.registry.note_result(rid_name, ok=False)
+                if (
+                    attempt < self.max_retries and _retryable(e)
+                    and force_replica is None
+                ):
+                    attempt += 1
+                    excluded.add(rid_name)
+                    obs.FLEET_RETRIES.inc()
+                    obs.flight.record(
+                        "fleet_retry", replica=rid_name, attempt=attempt,
+                        error=str(e)[:200],
+                    )
+                    self._backoff(attempt)
+                    continue
+                obs.FLEET_REQUESTS.inc(outcome="error")
+                raise
+            rid = resp.get("id") if isinstance(resp, dict) else None
+            self._record_decision(d, request_id=rid)
+            self._note_ownership(d, rid)
+            obs.FLEET_REQUESTS.inc(outcome="completed")
+            if isinstance(resp, dict):
+                resp.setdefault("fleet", {})["replica"] = \
+                    d.replica.replica_id
+                resp["fleet"]["policy"] = d.policy
+            return resp
 
     def complete_stream(
         self, body: dict[str, Any], force_replica: str | None = None
     ):
-        """Generator of SSE chunk dicts routed to the chosen replica."""
+        """Generator of SSE chunk dicts routed to the chosen replica.
+
+        Mid-stream failover: when the serving replica dies (transport
+        error, injected disconnect, or an in-band error chunk from a
+        dying engine), the request is re-submitted to a surviving
+        replica and the client stream RESUMES from the last emitted
+        character offset — greedy re-prefill regenerates the identical
+        text, the already-delivered prefix is skipped, so the client
+        sees no gap, no duplicate, and no error. Non-greedy streams only
+        fail over before the first content chunk (a resampled
+        continuation would splice two different generations)."""
         token_ids = self.tokenize(body)
-        d = self.route(body, token_ids, force_replica=force_replica)
-        if d.replica.handle is None:
-            raise RequestError(
-                f"replica {d.replica.replica_id} has no handle", 503
-            )
-        self._maybe_migrate(d, token_ids, reason="misroute")
-        self._maybe_prefill_lane(d, body, token_ids)
-        gen = d.replica.handle.chat_completion_stream(body)
-        first = True
+        self._check_overload(force_replica)
         try:
-            for chunk in gen:
-                if first:
-                    rid = chunk.get("id") if isinstance(chunk, dict) \
-                        else None
-                    self._record_decision(d, request_id=rid)
-                    self._note_ownership(d, rid)
-                    first = False
-                yield chunk
-            obs.FLEET_REQUESTS.inc(outcome="completed")
-        except Exception:
-            obs.FLEET_REQUESTS.inc(outcome="error")
-            raise
+            greedy = float(body.get("temperature") or 0.0) == 0.0
+        except (TypeError, ValueError):
+            greedy = False
+        excluded: set[str] = set()
+        failovers = 0
+        emitted_chars = 0     # content chars delivered to the client
+        sent_head = False     # role chunk already delivered
+        while True:
+            d = self.route(
+                body, token_ids, force_replica=force_replica,
+                exclude=excluded,
+            )
+            if d.replica.handle is None:
+                raise RequestError(
+                    f"replica {d.replica.replica_id} has no handle", 503
+                )
+            self._maybe_migrate(
+                d, token_ids,
+                reason="failover" if failovers else "misroute",
+            )
+            if failovers == 0:
+                self._maybe_prefill_lane(d, body, token_ids)
+            rid_name = d.replica.replica_id
+            skip_chars = emitted_chars   # dedup on re-submit
+            first = True
+            try:
+                gen = d.replica.handle.chat_completion_stream(body)
+                for chunk in gen:
+                    faults.maybe_raise(
+                        "fleet.stream_disconnect",
+                        ReplicaStreamBroken(
+                            "injected mid-SSE disconnect"
+                        ),
+                        replica=rid_name,
+                    )
+                    if isinstance(chunk, dict) and "error" in chunk:
+                        # In-band error chunk (the engine's scheduler
+                        # failed the request mid-decode): to the client
+                        # this replica is dead — fail over.
+                        raise ReplicaStreamBroken(str(
+                            chunk["error"].get("message", "stream error")
+                        ))
+                    if first:
+                        req_id = chunk.get("id") \
+                            if isinstance(chunk, dict) else None
+                        self._record_decision(d, request_id=req_id)
+                        self._note_ownership(d, req_id)
+                        first = False
+                    content = _chunk_content(chunk)
+                    if content:
+                        if skip_chars >= len(content):
+                            skip_chars -= len(content)
+                            continue
+                        if skip_chars > 0:
+                            chunk = _trim_chunk_content(chunk, skip_chars)
+                            content = content[skip_chars:]
+                            skip_chars = 0
+                        emitted_chars += len(content)
+                        yield chunk
+                        continue
+                    if _is_head_chunk(chunk):
+                        if sent_head:
+                            continue
+                        sent_head = True
+                    yield chunk
+                self.registry.note_result(rid_name, ok=True)
+                obs.FLEET_REQUESTS.inc(outcome="completed")
+                return
+            except Exception as e:  # noqa: BLE001 - classified below
+                self.registry.note_result(rid_name, ok=False)
+                resumable = greedy or emitted_chars == 0
+                if (
+                    failovers < self.max_failovers and _retryable(e)
+                    and resumable and force_replica is None
+                ):
+                    failovers += 1
+                    excluded.add(rid_name)
+                    obs.FLEET_FAILOVERS.inc()
+                    obs.flight.record(
+                        "failover", replica=rid_name,
+                        failovers=failovers,
+                        emitted_chars=emitted_chars,
+                        error=str(e)[:200], session=d.session,
+                    )
+                    self._backoff(failovers)
+                    continue
+                obs.FLEET_REQUESTS.inc(outcome="error")
+                raise
 
     # -- drain ----------------------------------------------------------------
     def drain(self, replica_id: str) -> dict[str, Any]:
@@ -813,6 +1159,18 @@ def build_router_app(router: FleetRouter):
             )
         force = request.query.get("replica") or None
         loop = asyncio.get_running_loop()
+
+        def _err_response(e: Exception) -> web.Response:
+            status = e.status if isinstance(e, RequestError) else 500
+            headers = {}
+            retry_after = getattr(e, "retry_after_s", None)
+            if status == 429 and retry_after is not None:
+                headers["Retry-After"] = str(int(retry_after))
+            return web.json_response(
+                {"error": {"message": str(e), "type": type(e).__name__}},
+                status=status, headers=headers,
+            )
+
         if body.get("stream"):
             gen = router.complete_stream(body, force_replica=force)
             try:
@@ -820,11 +1178,7 @@ def build_router_app(router: FleetRouter):
                     None, lambda: next(gen, None)
                 )
             except Exception as e:  # noqa: BLE001
-                status = e.status if isinstance(e, RequestError) else 500
-                return web.json_response(
-                    {"error": {"message": str(e), "type": type(e).__name__}},
-                    status=status,
-                )
+                return _err_response(e)
             resp = web.StreamResponse(headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
@@ -853,11 +1207,7 @@ def build_router_app(router: FleetRouter):
                 None, lambda: router.complete(body, force_replica=force)
             )
         except Exception as e:  # noqa: BLE001
-            status = e.status if isinstance(e, RequestError) else 500
-            return web.json_response(
-                {"error": {"message": str(e), "type": type(e).__name__}},
-                status=status,
-            )
+            return _err_response(e)
         return web.json_response(out)
 
     async def models(request: web.Request) -> web.Response:
@@ -876,7 +1226,7 @@ def build_router_app(router: FleetRouter):
     async def healthz(request: web.Request) -> web.Response:
         router.registry.refresh_local()
         replicas = router.registry.all()
-        return web.json_response({
+        out = {
             "status": "ok" if any(
                 not r.draining for r in replicas
             ) else "no_replicas",
@@ -886,7 +1236,13 @@ def build_router_app(router: FleetRouter):
             "prefill_lanes": sum(
                 1 for r in replicas if r.role == "prefill"
             ),
-        })
+            "health": router.registry.health_snapshot(),
+            "queued": sum(r.queue_depth() for r in replicas),
+            "shed_queue_depth": router.shed_queue_depth,
+        }
+        if faults.active():
+            out["faults"] = faults.summary()
+        return web.json_response(out)
 
     async def metrics(request: web.Request) -> web.Response:
         return web.Response(
@@ -1013,6 +1369,9 @@ def run_router_server(
     queue_spill: int | None = None,
     prefill_threshold: int = DEFAULT_PREFILL_THRESHOLD,
     heartbeat_ttl_s: float | None = None,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+    hedge_queue_depth: int | None = None,
+    shed_queue_depth: int | None = None,
 ) -> None:
     """``opsagent serve-router``: the fleet front-end as a process. The
     tokenizer (HF path, or the hermetic byte tokenizer by default) must
@@ -1030,6 +1389,9 @@ def run_router_server(
         prefill_threshold=prefill_threshold,
         tokenizer=load_tokenizer(tokenizer),
         model_family=model_name,
+        max_retries=max_retries,
+        hedge_queue_depth=hedge_queue_depth,
+        shed_queue_depth=shed_queue_depth,
     )
     app = build_router_app(router)
 

@@ -22,6 +22,7 @@ never alias another history's KV).
 from __future__ import annotations
 
 import base64
+import hashlib
 import time
 from typing import Any
 
@@ -29,32 +30,60 @@ import numpy as np
 
 from ... import obs
 from ...utils.logger import get_logger
+from .. import faults
 
 log = get_logger("fleet.transfer")
 
 
+def _record_digest(tokens: np.ndarray, blobs: list[bytes]) -> str:
+    """Integrity digest over a record's chain tokens + payload bytes.
+    Computed sender-side in pack_entries, verified receiver-side in
+    unpack_entries — a bit flip anywhere in transit rejects the page
+    (restore fallback: re-prefill) instead of restoring silent garbage."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    for blob in blobs:
+        h.update(blob)
+    return h.hexdigest()
+
+
 def pack_entries(entries: list[Any]) -> list[dict[str, Any]]:
     """HostPage entries -> JSON-safe transfer records (chain tokens +
-    per-leaf dtype/shape/base64 data)."""
+    per-leaf dtype/shape/base64 data + integrity digest)."""
     import jax
 
     out: list[dict[str, Any]] = []
     for e in entries:
         leaves = jax.tree_util.tree_leaves(e.data)
+        blobs = [
+            np.ascontiguousarray(leaf).tobytes() for leaf in leaves
+        ]
+        tokens = np.asarray(e.tokens, np.int32)
         out.append({
-            "tokens": np.asarray(e.tokens, np.int32).tolist(),
+            "tokens": tokens.tolist(),
+            "digest": _record_digest(tokens, blobs),
             "leaves": [
                 {
                     "dtype": str(np.asarray(leaf).dtype),
                     "shape": list(np.asarray(leaf).shape),
-                    "data": base64.b64encode(
-                        np.ascontiguousarray(leaf).tobytes()
-                    ).decode("ascii"),
+                    "data": base64.b64encode(blob).decode("ascii"),
                 }
-                for leaf in leaves
+                for leaf, blob in zip(leaves, blobs)
             ],
         })
     return out
+
+
+def _reject(rec: dict[str, Any], reason: str, **extra: Any) -> None:
+    """Drop a corrupt/malformed transfer record: counted, anomaly-dumped,
+    and only costs the receiver a re-prefill (restore fallback)."""
+    obs.FLEET_KV_IMPORT_REJECTS.inc()
+    obs.flight.anomaly(
+        "kv_import_reject", cause=reason,
+        tokens=len(rec.get("tokens") or ()), **extra,
+    )
+    log.warning("rejecting KV import record (%s); receiver re-prefills",
+                reason)
 
 
 def unpack_entries(
@@ -63,26 +92,54 @@ def unpack_entries(
     """Transfer records -> [(chain_tokens, page_tree)] rebuilt against
     ``template``'s pytree structure (any tree with the cache's structure —
     the engine cache itself works; leaf SHAPES in the template are
-    ignored). Records whose leaf count mismatches the template are
-    dropped with a log line."""
+    ignored). Records are verified before insertion: a leaf count that
+    mismatches the local cache structure, or a payload whose digest does
+    not match the sender's, is rejected (counter + anomaly dump) — the
+    receiver falls back to re-prefill, never restores corrupt KV.
+    Records without a digest (older senders) are structure-checked only."""
     import jax
 
     treedef = jax.tree_util.tree_structure(template)
     out: list[tuple[list[int], Any]] = []
     for rec in records:
-        specs = rec.get("leaves") or []
+        specs = list(rec.get("leaves") or [])
+        if faults.fire("transfer.truncate", tokens=len(rec.get("tokens") or ())):
+            specs = specs[:-1]   # injected: last leaf lost in transit
         if treedef.num_leaves != len(specs):
-            log.warning(
-                "transfer record leaf count %d != local cache structure "
-                "%d; dropping page", len(specs), treedef.num_leaves,
+            _reject(
+                rec, "structure_mismatch",
+                record_leaves=len(specs),
+                local_leaves=treedef.num_leaves,
             )
             continue
-        leaves = [
-            np.frombuffer(
-                base64.b64decode(s["data"]), dtype=np.dtype(s["dtype"])
-            ).reshape(s["shape"]).copy()
-            for s in specs
-        ]
+        try:
+            blobs = [base64.b64decode(s["data"]) for s in specs]
+        except (KeyError, ValueError, TypeError) as e:
+            _reject(rec, "undecodable_payload", error=str(e)[:120])
+            continue
+        if blobs and faults.fire(
+            "transfer.corrupt", tokens=len(rec.get("tokens") or ())
+        ):
+            b = bytearray(blobs[0])      # injected: flip one payload bit
+            b[len(b) // 2] ^= 0x01
+            blobs[0] = bytes(b)
+        digest = rec.get("digest")
+        if digest is not None:
+            got = _record_digest(
+                np.asarray(rec.get("tokens") or [], np.int32), blobs
+            )
+            if got != digest:
+                _reject(rec, "digest_mismatch", expected=digest, got=got)
+                continue
+        try:
+            leaves = [
+                np.frombuffer(blob, dtype=np.dtype(s["dtype"]))
+                .reshape(s["shape"]).copy()
+                for s, blob in zip(specs, blobs)
+            ]
+        except (KeyError, ValueError, TypeError) as e:
+            _reject(rec, "malformed_leaf", error=str(e)[:120])
+            continue
         out.append(
             ([int(t) for t in rec["tokens"]],
              jax.tree_util.tree_unflatten(treedef, leaves))

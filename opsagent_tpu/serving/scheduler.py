@@ -29,6 +29,7 @@ import numpy as np
 from .. import obs
 from ..utils.logger import get_logger
 from ..utils.perf import get_perf_stats
+from . import faults
 from .engine import Engine
 from .kvcache import InvalidRequest, OutOfPages, PromptTooLong
 from .sampler import SamplingParams
@@ -258,6 +259,10 @@ class Scheduler:
                 req.done.set()
                 continue
             def _begin(r: Request) -> int:
+                faults.maybe_raise(
+                    "sched.out_of_pages", OutOfPages,
+                    "injected OutOfPages storm",
+                )
                 return self.engine.begin_request(
                     r.prompt_ids,
                     r.sampling,
@@ -728,6 +733,14 @@ class Scheduler:
             try:
                 self._drain_queue()
                 self._try_admit()
+                if self._running or self._prefilling:
+                    # Only counted with work in flight: idle ticks spin
+                    # at an arbitrary rate, which would make hit-count
+                    # fault selectors wall-clock-dependent.
+                    faults.maybe_raise(
+                        "sched.step_fault", RuntimeError,
+                        "injected scheduler step fault",
+                    )
                 # Mixed tick first: one dispatch covers decode AND a
                 # prefill chunk (one weight stream). Falls back to the
                 # split prefill-then-decode tick when it cannot run.

@@ -201,6 +201,19 @@ def terminal_summary(paths: list[str]) -> int:
             f"re-prefill avoided {e.get('reprefill_avoided_tokens', 0)} "
             f"vs {e.get('off_reprefill_avoided_tokens', 0)} tok"
         )
+    chaos = [d for d in tpu if d["metric"].startswith("fleet_chaos")]
+    if chaos:
+        e = chaos[-1].get("extra", {})
+        print(
+            f"chaos A/B ({e.get('replicas', '?')} replicas, spec "
+            f"{e.get('spec', '?')!r}): {e.get('failed_requests', '?')} "
+            f"failed requests under {e.get('injected', 0)} injected "
+            f"faults ({e.get('failovers', 0)} failovers, "
+            f"{e.get('retries', 0)} retries, {e.get('shed', 0)} shed); "
+            f"p99 TTFT {e.get('p99_ttft_ms', 0)} ms (chaos) vs "
+            f"{e.get('off_p99_ttft_ms', 0)} ms (clean); outputs "
+            f"identical: {e.get('outputs_identical')}"
+        )
     agent = [d for d in tpu if d["metric"].startswith("agent_turn_ttft")]
     if agent:
         best_a = min(agent, key=lambda d: d["value"])

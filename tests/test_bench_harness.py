@@ -291,3 +291,37 @@ def test_fleet_affinity_mode_reports_ab_numbers():
     # The router's placement telemetry rode along.
     assert any("pinned" in k for k in e["route_decisions"])
     assert any("round_robin" in k for k in e["route_decisions"])
+
+
+def test_fleet_chaos_mode_zero_failed_requests_under_faults():
+    """OPSAGENT_BENCH_MODE=fleet-chaos (the tier-1-safe fast-lane form of
+    the chaos A/B stage: CPU, tiny model, 2 in-process replicas, seeded
+    mid-SSE disconnects) must run the streaming workload fault-free and
+    then under the injector, and emit BOTH phases in ONE JSON line. The
+    containment claim: the chaos phase ends with ZERO failed requests
+    and at least one recorded failover — every injected disconnect was
+    absorbed by the router, and greedy outputs match the clean run
+    byte-for-byte."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "fleet-chaos",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("fleet_chaos[")
+    assert parsed["unit"] == "failed_requests"
+    assert parsed["value"] == 0
+    e = parsed["extra"]
+    assert e["replicas"] == 2
+    # The injector actually fired, and every fault was contained.
+    assert e["injected"] >= 1
+    assert e["failovers"] >= 1
+    assert e["failed_requests"] == 0
+    assert e["off_failed_requests"] == 0
+    assert e["outputs_identical"] is True
+    # Both phases measured the containment cost.
+    assert e["p99_ttft_ms"] > 0 and e["off_p99_ttft_ms"] > 0

@@ -14,6 +14,7 @@ the never-drained run.
 """
 
 import asyncio
+import base64
 import json
 import threading
 import time
@@ -162,6 +163,43 @@ def test_unpack_drops_structure_mismatch():
     records = pack_entries(pool.entries_for([1, 2, 3, 4]))
     bad_template = {"k": np.zeros(1), "v": np.zeros(1)}  # 2 leaves != 1
     assert unpack_entries(records, bad_template) == []
+
+
+def test_unpack_rejects_tampered_payload_by_digest():
+    """A bit flipped in transit (proxy truncation, buggy middlebox) must
+    not be imported into the receiver's KV pool: every record carries a
+    digest over tokens + leaf bytes, checked at import."""
+    from opsagent_tpu import obs
+
+    pool = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+    tree = {"k": np.arange(8, dtype=np.float32).reshape(2, 4)}
+    pool.put([1, 2, 3, 4], tree)
+    records = pack_entries(pool.entries_for([1, 2, 3, 4]))
+    assert records[0]["digest"]
+    blob = bytearray(base64.b64decode(records[0]["leaves"][0]["data"]))
+    blob[0] ^= 0xFF
+    records[0]["leaves"][0]["data"] = base64.b64encode(bytes(blob)).decode()
+    assert unpack_entries(records, tree) == []
+    assert obs.FLEET_KV_IMPORT_REJECTS.value() == 1
+    rejects = [
+        e for e in obs.flight.get_recorder().snapshot(kind="anomaly")
+        if e.get("reason") == "kv_import_reject"
+    ]
+    assert rejects and rejects[-1]["cause"] == "digest_mismatch"
+
+
+def test_unpack_accepts_legacy_records_without_digest():
+    """Records from a pre-digest sender (rolling fleet upgrade) still
+    import; digest checking is enforced only when the field is present."""
+    pool = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+    tree = {"k": np.arange(8, dtype=np.float32).reshape(2, 4)}
+    pool.put([1, 2, 3, 4], tree)
+    records = pack_entries(pool.entries_for([1, 2, 3, 4]))
+    for r in records:
+        r.pop("digest", None)
+    out = unpack_entries(records, tree)
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0][1]["k"], tree["k"])
 
 
 # -- acceptance (a): prefix-affinity routing restores on the owner ------------
