@@ -71,6 +71,11 @@ OPSAGENT_BENCH_MODE=agent runs the north-star agent shape instead:
 multi-turn ReAct sessions (observation-as-user-message, full-history
 resend) with the prefix cache on, reporting p50 client TTFT per
 tool-call turn and the prefix-hit rate.
+OPSAGENT_BENCH_MODE=cold-start runs the snapshot/restore A/B
+(serving/snapshot): fresh-init request-ready vs Engine.from_snapshot
+request-ready against empty compile caches, with byte-identical greedy
+outputs and the zero-post-warmup-compiles invariant checked on the
+restored engine.
 """
 
 from __future__ import annotations
@@ -518,6 +523,18 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_STEPS": "64"},
         120, "cold-restart",
     ) if on_tpu else None
+    # Cold-start A/B (ROADMAP item 4): fresh-init vs snapshot-restore
+    # request-ready time in one child, both against empty compile caches
+    # (the restore's cache holds only what the snapshot packaged), with
+    # byte-identical greedy outputs and zero post-warmup compiles
+    # asserted on the restored engine. The acceptance bar is restore
+    # <= 0.5x fresh.
+    rcoldstart = stage(
+        {"OPSAGENT_BENCH_MODE": "cold-start",
+         "OPSAGENT_BENCH_MODEL": "bench-1b",
+         "OPSAGENT_BENCH_STEPS": "64"},
+        150, "cold-start",
+    ) if on_tpu else None
     # Speculative overhead LAST: the question is already answered by
     # measurement (k=4 was -76 % on chip; accept rate 6.6 % on the
     # trained agent; default 0) — under a tight driver budget the
@@ -615,6 +632,21 @@ def run_orchestrated() -> None:
         extra["cold_restart_first_ttft_ms"] = ce.get("first_ttft_ms")
         extra["cold_restart_init_s"] = ce.get("init_s")
         extra["cold_restart_warmup_s"] = ce.get("warmup_s")
+    if rcoldstart is not None:
+        cse = rcoldstart.get("extra", {})
+        extra["cold_start_fresh_request_ready_s"] = cse.get(
+            "fresh_request_ready_s"
+        )
+        extra["cold_start_restore_request_ready_s"] = cse.get(
+            "restore_request_ready_s"
+        )
+        extra["cold_start_speedup_ratio"] = cse.get("speedup_ratio")
+        extra["cold_start_outputs_identical"] = cse.get(
+            "outputs_identical"
+        )
+        extra["cold_start_post_warmup_compiles"] = cse.get(
+            "post_warmup_compiles"
+        )
     out = dict(headline, extra=extra)
     print(json.dumps(out), flush=True)
     # The children already gated themselves; re-check the headline's
@@ -624,7 +656,8 @@ def run_orchestrated() -> None:
     # printed, so the verdict can never eat a result line.
     exit_if_perf_regression([
         r1, r8b, r8b4, r8bkv, r8b4kv, rsess, rsessmix, rsessasync,
-        rsessoff, rfleet, rchaos, ragent, rdma, rdmakv, rcold, rspec,
+        rsessoff, rfleet, rchaos, ragent, rdma, rdmakv, rcold,
+        rcoldstart, rspec,
     ])
 
 
@@ -666,7 +699,8 @@ def run_single() -> None:
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
-                "sessions-async", "fleet-affinity", "fleet-chaos"):
+                "sessions-async", "fleet-affinity", "fleet-chaos",
+                "cold-start"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -763,6 +797,12 @@ def run_single() -> None:
             f"lookahead {lookahead}); raise OPSAGENT_BENCH_MAXPAGES or "
             f"lower OPSAGENT_BENCH_STEPS"
         )
+    if mode == "cold-start":
+        # Builds its own engines (fresh then restored) — intercept before
+        # the shared construction below.
+        run_cold_start(cfg, model, batch, steps, prompt_len, platform,
+                       n_chips, quantize)
+        return
     t0 = time.perf_counter()
     eng = Engine(cfg)
     init_s = time.perf_counter() - t0
@@ -897,6 +937,99 @@ def run_single() -> None:
         },
     }), flush=True)
     exit_if_slo_breach(slo_verdicts())
+
+
+def run_cold_start(cfg, model, batch, steps, prompt_len, platform,
+                   n_chips, quantize) -> None:
+    """Cold-start A/B (ROADMAP item 4): fresh-init request-ready time vs
+    snapshot-restore request-ready time in one child, greedy outputs
+    verified byte-identical across the two engines.
+
+    Phase 1 builds + warms an engine against an EMPTY persistent compile
+    cache (the honest first-boot cost), drives a short greedy decode,
+    then snapshots it. ``jax.clear_caches()`` drops the in-process
+    executable caches before phase 2, so the restore cannot coast on
+    them: phase 2 restores into a SECOND empty cache dir whose only
+    content is what the snapshot packaged — exactly what a scale-out
+    replica on a new host experiences."""
+    import gc
+    import shutil
+    import tempfile
+
+    from opsagent_tpu import obs
+    from opsagent_tpu.serving.engine import Engine
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    work = tempfile.mkdtemp(prefix="opsagent-coldstart-")
+    cache_a = os.path.join(work, "cache-fresh")
+    cache_b = os.path.join(work, "cache-restore")
+    snapdir = os.path.join(work, "snapshot")
+    os.makedirs(cache_a)
+    os.makedirs(cache_b)
+    # Every warmed program must land in the persistent cache for the
+    # snapshot to package it — drop the min-compile-time floor.
+    os.environ["OPSAGENT_COMPILE_CACHE_MIN_S"] = "0"
+    os.environ["OPSAGENT_COMPILE_CACHE_DIR"] = cache_a
+
+    t0 = time.perf_counter()
+    eng = Engine(cfg)
+    eng.warmup("bench")
+    fresh_s = time.perf_counter() - t0
+    log(f"bench: fresh init -> request-ready {fresh_s:.1f}s")
+
+    rng = np.random.default_rng(0)
+    vocab = eng.model_cfg.vocab_size
+    prompts = [rng.integers(1, vocab, size=prompt_len).tolist()
+               for _ in range(batch)]
+    sampling = SamplingParams(temperature=0.0, max_tokens=steps)
+    fresh_out = eng.generate(prompts, sampling)
+
+    man = eng.snapshot(snapdir)
+    del eng
+    gc.collect()
+    jax.clear_caches()
+
+    os.environ["OPSAGENT_COMPILE_CACHE_DIR"] = cache_b
+    t0 = time.perf_counter()
+    eng2 = Engine.from_snapshot(snapdir, warmup="bench")
+    restore_s = time.perf_counter() - t0
+    preseeded = eng2.init_stats.get("compile_cache_preseeded", 0)
+    log(f"bench: snapshot restore -> request-ready {restore_s:.1f}s "
+        f"({preseeded} compile-cache entries pre-seeded)")
+
+    gauge0 = obs.POST_WARMUP_COMPILES.value()
+    restore_out = eng2.generate(prompts, sampling)
+    post_compiles = obs.POST_WARMUP_COMPILES.value() - gauge0
+    identical = fresh_out == restore_out
+    speedup = fresh_s / restore_s if restore_s > 0 else 0.0
+    log(f"bench: cold-start speedup {speedup:.1f}x, outputs identical: "
+        f"{identical}, post-warmup compiles on restore: {post_compiles}")
+
+    qtag = f",{quantize}" if quantize else ""
+    if cfg.kv_quantize:
+        qtag += f",kv-{cfg.kv_quantize}"
+    print(json.dumps({
+        "metric": f"cold_start_request_ready[{model}{qtag},{platform}]",
+        "value": round(restore_s, 2),
+        "unit": "request_ready_s",
+        "extra": {
+            "fresh_request_ready_s": round(fresh_s, 2),
+            "restore_request_ready_s": round(restore_s, 2),
+            "speedup_ratio": round(speedup, 2),
+            "outputs_identical": identical,
+            "post_warmup_compiles": post_compiles,
+            "restore_weights_load_s": eng2.init_stats.get("weights_load_s"),
+            "restore_warmup_s": eng2.init_stats.get("warmup_s"),
+            "compile_cache_preseeded": preseeded,
+            "snapshot_leaves": len(man["leaves"]),
+            "snapshot_compile_cache_entries":
+                man["compile_cache"]["entries"],
+            "snapshot_fingerprint": man["fingerprint"],
+            "chips": n_chips,
+            "platform": platform,
+        },
+    }), flush=True)
+    shutil.rmtree(work, ignore_errors=True)
 
 
 def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,

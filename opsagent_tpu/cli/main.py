@@ -231,10 +231,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="stable replica identity in the fleet (default: random)",
     )
     se.add_argument(
-        "--replica-role", default="decode", choices=("decode", "prefill"),
+        "--replica-role", default="decode",
+        choices=("decode", "prefill", "standby"),
         help="decode replicas serve sessions end-to-end; prefill "
              "replicas take the router's long cold admissions and hand "
-             "their KV to a decode replica over the transfer path",
+             "their KV to a decode replica over the transfer path; "
+             "standby replicas are registered but unroutable until the "
+             "router's autoscaler promotes them to decode",
+    )
+    se.add_argument(
+        "--restore-snapshot", default="",
+        help="boot from an `opsagent snapshot create` directory instead "
+             "of fresh init: weights mmap straight to device in recorded "
+             "layout and warmup replays the packaged compile cache — "
+             "model/engine flags are taken from the snapshot",
+    )
+    se.add_argument(
+        "--compile-cache-dir", default="",
+        help="persistent XLA compile cache directory (sets "
+             "OPSAGENT_COMPILE_CACHE_DIR; survives restarts, shared "
+             "across processes)",
     )
 
     sr = sub.add_parser(
@@ -295,6 +311,78 @@ def build_parser() -> argparse.ArgumentParser:
         "--shed-queue-depth", type=int, default=None,
         help="overload shedding: 429 + Retry-After for new admissions "
              "once EVERY replica's queue is this deep (default: off)",
+    )
+    sr.add_argument(
+        "--autoscale-snapshot", default="",
+        help="elastic scale-out: launch standby replicas from this "
+             "`opsagent snapshot create` directory when shed pressure "
+             "appears, promote them once request-ready (default: off; "
+             "pair with --shed-queue-depth, the scale-up signal)",
+    )
+    sr.add_argument(
+        "--autoscale-max-replicas", type=int, default=4,
+        help="upper bound on autoscaler-launched replicas",
+    )
+    sr.add_argument(
+        "--autoscale-port-base", type=int, default=8400,
+        help="first port for autoscaler-launched engine servers "
+             "(sequential from here)",
+    )
+    sr.add_argument(
+        "--autoscale-cooldown", type=float, default=30.0,
+        help="seconds between autoscaler launches",
+    )
+
+    sn = sub.add_parser(
+        "snapshot",
+        help="engine snapshot lifecycle: `create` captures a fully-"
+             "warmed engine (weights in device layout + compile cache + "
+             "KV plan) as a restart artifact; `verify` checks one "
+             "without importing jax (serving/snapshot)",
+    )
+    snsub = sn.add_subparsers(dest="snapshot_cmd", required=True)
+    snc = snsub.add_parser(
+        "create",
+        help="build + warm an engine, then write its snapshot directory",
+    )
+    snc.add_argument("--out", required=True, help="snapshot directory")
+    snc.add_argument("--model", default="tiny-test")
+    snc.add_argument("--checkpoint", default="")
+    snc.add_argument("--tokenizer", default="")
+    snc.add_argument("--tp", type=int, default=0)
+    snc.add_argument("--sp", type=int, default=1)
+    snc.add_argument("--ep", type=int, default=1)
+    snc.add_argument("--max-batch-size", type=int, default=8)
+    snc.add_argument("--quantize", default="", choices=("", "int8"))
+    snc.add_argument("--kv-quantize", default="", choices=("", "int8"))
+    snc.add_argument("--speculative-k", type=int, default=0)
+    snc.add_argument("--offload", action="store_true", default=False)
+    snc.add_argument("--async-depth", type=int, default=2)
+    snc.add_argument(
+        "--warmup-level", default="full",
+        help="warmup sweep before capture (full/bench/bench-spec/"
+             "sessions): whatever compiles here is what restore replays "
+             "as cache hits",
+    )
+    snc.add_argument(
+        "--compile-cache-dir", default="",
+        help="compile cache to populate and package (default: "
+             "OPSAGENT_COMPILE_CACHE_DIR, else a temp dir for the "
+             "duration of the capture)",
+    )
+    snc.add_argument(
+        "--platform", default="", choices=("", "tpu", "cpu"),
+        help="force the JAX platform (default: environment's choice)",
+    )
+    snv = snsub.add_parser(
+        "verify",
+        help="check a snapshot's manifest, fingerprint, and weight-leaf "
+             "digests (exit 0 ok / 1 failed / 2 unreadable)",
+    )
+    snv.add_argument("path", help="snapshot directory")
+    snv.add_argument(
+        "--quick", action="store_true", default=False,
+        help="skip per-leaf content digests (existence + size only)",
     )
 
     return p
@@ -444,6 +532,8 @@ def main(argv: list[str] | None = None) -> int:
             advertise=args.advertise,
             replica_id=args.replica_id,
             replica_role=args.replica_role,
+            restore_snapshot=args.restore_snapshot,
+            compile_cache_dir=args.compile_cache_dir,
         )
         return 0
 
@@ -464,7 +554,80 @@ def main(argv: list[str] | None = None) -> int:
             max_retries=args.max_retries,
             hedge_queue_depth=args.hedge_queue_depth,
             shed_queue_depth=args.shed_queue_depth,
+            autoscale_snapshot=args.autoscale_snapshot,
+            autoscale_max_replicas=args.autoscale_max_replicas,
+            autoscale_port_base=args.autoscale_port_base,
+            autoscale_cooldown_s=args.autoscale_cooldown,
         )
+        return 0
+
+    if args.command == "snapshot":
+        import json as _json
+
+        if args.snapshot_cmd == "verify":
+            # jax-free on purpose: manifest.py only touches stdlib, so
+            # this runs on any CI box that can read the artifact.
+            from ..serving.snapshot.manifest import (
+                SnapshotError,
+                verify_snapshot,
+            )
+
+            try:
+                report = verify_snapshot(args.path, quick=args.quick)
+            except SnapshotError as e:
+                print(f"snapshot unreadable: {e}", file=sys.stderr)
+                return 2
+            print(_json.dumps(report, indent=2))
+            return 0 if report["ok"] else 1
+
+        # snapshot create: build + warm a real engine, then capture it.
+        # Every compile must land in the persistent cache for the
+        # snapshot to carry it, so drop the min-compile-time floor
+        # before jax spins up.
+        os.environ.setdefault("OPSAGENT_COMPILE_CACHE_MIN_S", "0")
+        if args.compile_cache_dir:
+            os.environ["OPSAGENT_COMPILE_CACHE_DIR"] = args.compile_cache_dir
+        elif not (
+            os.environ.get("OPSAGENT_COMPILE_CACHE_DIR")
+            or os.environ.get("OPSAGENT_COMPILE_CACHE")
+        ):
+            import tempfile
+
+            os.environ["OPSAGENT_COMPILE_CACHE_DIR"] = tempfile.mkdtemp(
+                prefix="opsagent-snapshot-cache-"
+            )
+        if args.platform:
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
+        from ..models.config import resolve_model
+        from ..serving.engine import Engine, EngineConfig
+
+        model_name, model_cfg = resolve_model(args.model, args.checkpoint)
+        eng_cfg = EngineConfig(
+            model=model_name,
+            checkpoint=args.checkpoint,
+            tokenizer=args.tokenizer,
+            tp=args.tp,
+            sp=args.sp,
+            ep=args.ep,
+            max_batch_size=args.max_batch_size,
+            quantize=args.quantize,
+            kv_quantize=args.kv_quantize,
+            speculative_k=args.speculative_k,
+            offload=args.offload,
+            async_depth=args.async_depth,
+            warmup=False,
+        )
+        eng = Engine(eng_cfg, model_cfg=model_cfg)
+        eng.warmup(args.warmup_level)
+        man = eng.snapshot(args.out)
+        print(_json.dumps({
+            "path": os.path.abspath(args.out),
+            "fingerprint": man["fingerprint"],
+            "leaves": len(man["leaves"]),
+            "compile_cache_entries": man["compile_cache"]["entries"],
+        }, indent=2))
         return 0
 
     from ..utils.term import render_markdown

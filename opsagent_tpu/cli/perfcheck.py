@@ -97,7 +97,10 @@ def default_baseline() -> str | None:
 
 def _higher_better(unit: str) -> bool:
     u = (unit or "").lower()
-    if u in ("ms", "s", "seconds", "failed_requests", "errors"):
+    if u in (
+        "ms", "s", "seconds", "failed_requests", "errors",
+        "request_ready_s",
+    ):
         return False
     return True  # tok/s/chip and friends
 

@@ -11,12 +11,16 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.logger import get_logger
 from .config import ModelConfig
+
+log = get_logger("loader")
 
 
 class CheckpointError(Exception):
@@ -246,7 +250,13 @@ def load_checkpoint(
     """Load an HF llama/qwen/deepseek-moe checkpoint into the stacked param
     layout. MoE layers use the DeepSeek naming scheme: ``mlp.gate.weight``
     (router), ``mlp.experts.{e}.{gate,up,down}_proj.weight``, and fused
-    ``mlp.shared_experts.{gate,up,down}_proj.weight``."""
+    ``mlp.shared_experts.{gate,up,down}_proj.weight``.
+
+    This is the slow cold-start path: a host-side parse + transpose +
+    restack of every tensor. ``Engine.from_snapshot`` bypasses it
+    entirely — snapshot restore (serving/snapshot/restore.py) memory-maps
+    leaves already in this stacked device layout."""
+    t0 = time.perf_counter()
     tensors = _open_shards(path)
     L = cfg.num_layers
     Ld = cfg.moe_layer_start if cfg.moe is not None else L
@@ -352,6 +362,10 @@ def load_checkpoint(
             f"embed shape {(v, d)} does not match config "
             f"({cfg.vocab_size}, {cfg.hidden_size})"
         )
+    log.info(
+        "checkpoint %s parsed/restacked in %.1f s", path,
+        time.perf_counter() - t0,
+    )
     return params
 
 

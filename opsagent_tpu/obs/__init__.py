@@ -324,6 +324,40 @@ FLEET_KV_IMPORT_REJECTS = _reg.counter(
     "structure mismatch); the receiver re-prefills instead",
 )
 
+# -- cold start: engine snapshot/restore + elastic autoscaling ----------------
+SNAPSHOT_OPS = _reg.counter(
+    "opsagent_snapshot_ops_total",
+    "Engine snapshot operations by kind (write = snapshot created, "
+    "restore = engine restored, refused = fingerprint/device/leaf-order "
+    "mismatch rejected)",
+    labelnames=("op",),
+)
+SNAPSHOT_WRITE_SECONDS = _reg.histogram(
+    "opsagent_snapshot_write_seconds",
+    "Wall time to write one engine snapshot (weights device_get + leaf "
+    "files + compile-cache copy + manifest)",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+)
+SNAPSHOT_RESTORE_SECONDS = _reg.histogram(
+    "opsagent_snapshot_restore_seconds",
+    "Wall time from reading a snapshot manifest to a request-ready "
+    "engine (mmap + device_put + cache-hit warmup sweep)",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+)
+SNAPSHOT_BYTES = _reg.gauge(
+    "opsagent_snapshot_bytes",
+    "Size of the last snapshot written, by part (weights / "
+    "compile_cache)",
+    labelnames=("part",),
+)
+FLEET_SCALE_EVENTS = _reg.counter(
+    "opsagent_fleet_scale_events_total",
+    "Autoscaler actions by direction (up = standby replica launched "
+    "from the snapshot, promote = request-ready standby admitted to "
+    "decode rotation, down = idle autoscaled replica drained)",
+    labelnames=("direction",),
+)
+
 # -- request lifecycle --------------------------------------------------------
 ENGINE_REQUESTS = _reg.counter(
     "opsagent_engine_requests_total",

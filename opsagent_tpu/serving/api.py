@@ -725,6 +725,10 @@ def build_engine_app(stack: ServingStack, membership=None):
             "prefix_miss_tokens": eng.alloc.miss_tokens,
             "prefix_evictions": eng.alloc.evictions,
         }
+        if getattr(eng, "init_stats", None):
+            # Cold-start provenance: how long weights + warmup took, and
+            # whether this engine came up fresh or from a snapshot.
+            body["init"] = dict(eng.init_stats)
         if getattr(eng, "offload", None) is not None:
             body["host_pool"] = eng.offload.stats()
         if getattr(eng.cfg, "async_depth", 1) > 1:
@@ -1060,6 +1064,22 @@ def build_engine_app(stack: ServingStack, membership=None):
             "running": len(stack.engine.sequences),
         })
 
+    async def fleet_promote(request: web.Request) -> web.Response:
+        # Autoscaler-initiated role change (standby -> decode): keeps the
+        # replica's self-reported role in sync with the router registry
+        # so a later full re-register doesn't demote it back to standby.
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            body = {}
+        role = str(body.get("role", "decode"))
+        if membership is not None:
+            membership.promote(role)
+        return web.json_response({
+            "status": "ok",
+            "role": membership.role if membership is not None else role,
+        })
+
     app = web.Application(client_max_size=256 * 1024 * 1024)
     app.router.add_post("/v1/chat/completions", completions)
     app.router.add_get("/v1/models", models)
@@ -1078,6 +1098,7 @@ def build_engine_app(stack: ServingStack, membership=None):
     app.router.add_post("/fleet/kv/export", fleet_kv_export)
     app.router.add_post("/fleet/kv/import", fleet_kv_import)
     app.router.add_post("/fleet/drain", fleet_drain)
+    app.router.add_post("/fleet/promote", fleet_promote)
     return app
 
 
@@ -1100,38 +1121,58 @@ def run_engine_server(
     advertise: str = "",
     replica_id: str = "",
     replica_role: str = "decode",
+    restore_snapshot: str = "",
+    compile_cache_dir: str = "",
 ) -> None:
+    import os
+
     from aiohttp import web
 
     from ..models.config import resolve_model
 
-    model_name, model_cfg = resolve_model(model_name, checkpoint)
-    if model_cfg is not None:
-        log.info(
-            "config.json -> %s: %dL d=%d heads=%d/%d vocab=%d",
-            model_name, model_cfg.num_layers, model_cfg.hidden_size,
-            model_cfg.num_heads, model_cfg.num_kv_heads,
-            model_cfg.vocab_size,
-        )
+    if compile_cache_dir:
+        os.environ["OPSAGENT_COMPILE_CACHE_DIR"] = compile_cache_dir
 
-    cfg = EngineConfig(
-        model=model_name,
-        checkpoint=checkpoint,
-        tokenizer=tokenizer,
-        tp=tp,
-        sp=sp,
-        ep=ep,
-        max_batch_size=max_batch_size,
-        quantize=quantize,
-        kv_quantize=kv_quantize,
-        speculative_k=speculative_k,
-        offload=offload,
-        async_depth=async_depth,
-        # Production server: compile everything before accepting requests
-        # so no client ever pays XLA compile inside its TTFT.
-        warmup=True,
-    )
-    engine = Engine(cfg, model_cfg=model_cfg)
+    if restore_snapshot:
+        # Cold-start fast path: the snapshot IS the engine config —
+        # model/engine flags on the command line are ignored (the
+        # fingerprint check would refuse anything else anyway).
+        if checkpoint or model_name != "tiny-test":
+            log.warning(
+                "--restore-snapshot overrides --model/--checkpoint: "
+                "engine comes up exactly as snapshotted from %s",
+                restore_snapshot,
+            )
+        engine = Engine.from_snapshot(restore_snapshot, warmup=True)
+        model_name = engine.cfg.model
+    else:
+        model_name, model_cfg = resolve_model(model_name, checkpoint)
+        if model_cfg is not None:
+            log.info(
+                "config.json -> %s: %dL d=%d heads=%d/%d vocab=%d",
+                model_name, model_cfg.num_layers, model_cfg.hidden_size,
+                model_cfg.num_heads, model_cfg.num_kv_heads,
+                model_cfg.vocab_size,
+            )
+
+        cfg = EngineConfig(
+            model=model_name,
+            checkpoint=checkpoint,
+            tokenizer=tokenizer,
+            tp=tp,
+            sp=sp,
+            ep=ep,
+            max_batch_size=max_batch_size,
+            quantize=quantize,
+            kv_quantize=kv_quantize,
+            speculative_k=speculative_k,
+            offload=offload,
+            async_depth=async_depth,
+            # Production server: compile everything before accepting requests
+            # so no client ever pays XLA compile inside its TTFT.
+            warmup=True,
+        )
+        engine = Engine(cfg, model_cfg=model_cfg)
     stack = ServingStack(engine)
     install_stack(model_name, stack)
     membership = None

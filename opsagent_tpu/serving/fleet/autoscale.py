@@ -1,0 +1,338 @@
+"""Elastic fleet autoscaling: shed pressure -> standby replicas.
+
+The scaling loop closes over signals the fleet already emits rather
+than inventing new ones: the router's shed decision (_check_overload —
+every decode queue at the watermark) is the scale-UP trigger, sustained
+idleness of a scaler-launched replica is the scale-DOWN trigger, and
+scale-down itself is just the existing graceful drain
+(FleetRouter.drain), so no session sees an error when capacity leaves.
+
+What makes scale-up worth doing at all is the snapshot subsystem
+(serving/snapshot/): a standby launched with ``serve-engine
+--restore-snapshot`` mmaps weights and replays the compile cache, so it
+reaches request-ready in a fraction of fresh-init time — inside the
+window a traffic spike is still going on. Launched replicas join with
+``role="standby"`` (the router's route() only considers ``decode``),
+and the scaler promotes them once /healthz says request-ready, so a
+half-initialized engine can never receive traffic.
+
+``ReplicaLauncher`` is the placement boundary: ``SubprocessLauncher``
+spawns serve-engine processes on this host (the shipped implementation
+— one TPU host, multiple small-mesh replicas), ``LocalStackLauncher``
+builds in-process stacks (tests). A k8s/GKE launcher is the same three
+methods against an API server.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+import json as _json
+from typing import Any, Callable
+
+from ... import obs
+from ...utils.logger import get_logger
+
+log = get_logger("autoscale")
+
+
+class ReplicaLauncher:
+    """Minimal placement interface the Autoscaler drives."""
+
+    def launch(self, replica_id: str) -> None:
+        """Start a replica that will (eventually) appear in the router's
+        registry with ``role="standby"``. Must not block on init."""
+        raise NotImplementedError
+
+    def request_ready(self, replica_id: str) -> bool:
+        """True once the replica can serve requests (weights on device,
+        warmup done)."""
+        raise NotImplementedError
+
+    def stop(self, replica_id: str) -> None:
+        """Tear the replica down (after the router has drained it)."""
+        raise NotImplementedError
+
+
+class LocalStackLauncher(ReplicaLauncher):
+    """In-process launcher for tests: ``stack_factory()`` returns a
+    ready ServingStack (e.g. built around ``Engine.from_snapshot``)."""
+
+    def __init__(self, router: Any, stack_factory: Callable[[], Any]):
+        self.router = router
+        self.stack_factory = stack_factory
+        self._stacks: dict[str, Any] = {}
+
+    def launch(self, replica_id: str) -> None:
+        stack = self.stack_factory()
+        self._stacks[replica_id] = stack
+        self.router.add_local(stack, replica_id, role="standby")
+
+    def request_ready(self, replica_id: str) -> bool:
+        return replica_id in self._stacks
+
+    def stop(self, replica_id: str) -> None:
+        stack = self._stacks.pop(replica_id, None)
+        if stack is not None:
+            stack.close()
+
+
+class SubprocessLauncher(ReplicaLauncher):
+    """Launch standby replicas as serve-engine subprocesses restoring
+    from a snapshot, joining the fleet over HTTP on sequential ports."""
+
+    def __init__(
+        self,
+        snapshot_path: str,
+        router_url: str,
+        host: str = "127.0.0.1",
+        port_base: int = 8400,
+    ):
+        self.snapshot_path = snapshot_path
+        self.router_url = router_url
+        self.host = host
+        self.port_base = port_base
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._urls: dict[str, str] = {}
+        self._next_port = port_base
+
+    def launch(self, replica_id: str) -> None:
+        port = self._next_port
+        self._next_port += 1
+        url = f"http://{self.host}:{port}"
+        cmd = [
+            sys.executable, "-m", "opsagent_tpu.cli.main", "serve-engine",
+            "--restore-snapshot", self.snapshot_path,
+            "--host", self.host, "--port", str(port),
+            "--join-fleet", self.router_url,
+            "--advertise", url,
+            "--replica-id", replica_id,
+            "--replica-role", "standby",
+        ]
+        self._procs[replica_id] = subprocess.Popen(cmd)
+        self._urls[replica_id] = url
+        log.info(
+            "launched standby %s on %s (pid %d, snapshot %s)",
+            replica_id, url, self._procs[replica_id].pid,
+            self.snapshot_path,
+        )
+
+    def request_ready(self, replica_id: str) -> bool:
+        url = self._urls.get(replica_id)
+        proc = self._procs.get(replica_id)
+        if url is None or proc is None or proc.poll() is not None:
+            return False
+        try:
+            with urllib.request.urlopen(url + "/healthz", timeout=2) as r:
+                return _json.loads(r.read()).get("status") == "ok"
+        except Exception:  # noqa: BLE001 - still booting
+            return False
+
+    def stop(self, replica_id: str) -> None:
+        proc = self._procs.pop(replica_id, None)
+        self._urls.pop(replica_id, None)
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+class Autoscaler:
+    """Shed-driven scale-up, idleness-driven scale-down.
+
+    One launch is in flight at a time (a standby that hasn't been
+    promoted yet absorbs the pressure signal — launching more while the
+    first is still warming just thunders the herd), bounded by
+    ``max_replicas`` scaler-launched replicas and a post-launch
+    ``cooldown_s``. Tests drive ``tick()`` directly; production uses
+    ``start()``'s daemon thread."""
+
+    def __init__(
+        self,
+        router: Any,
+        launcher: ReplicaLauncher,
+        max_replicas: int = 4,
+        cooldown_s: float = 30.0,
+        queue_high: int | None = None,
+        scale_down_after: int = 10,
+        interval_s: float = 2.0,
+    ):
+        self.router = router
+        self.launcher = launcher
+        self.max_replicas = max_replicas
+        self.cooldown_s = cooldown_s
+        self.queue_high = queue_high
+        self.scale_down_after = scale_down_after
+        self.interval_s = interval_s
+        self._lock = threading.Lock()
+        self._shed = 0
+        self._seq = 0
+        self._last_launch = 0.0
+        self._pending: set[str] = set()    # launched, not yet promoted
+        self._active: set[str] = set()     # promoted, scaler-owned
+        self._idle_ticks: dict[str, int] = {}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.launched_total = 0
+        self.promoted_total = 0
+        self.retired_total = 0
+
+    # -- signals -----------------------------------------------------------
+    def note_shed(self) -> None:
+        """Called by FleetRouter._check_overload on every shed 429."""
+        with self._lock:
+            self._shed += 1
+
+    def _take_shed(self) -> int:
+        with self._lock:
+            n, self._shed = self._shed, 0
+            return n
+
+    # -- the loop ----------------------------------------------------------
+    def tick(self) -> dict[str, Any]:
+        """One scaling decision. Returns what it did (for tests/logs)."""
+        reg = self.router.registry
+        reg.refresh_local()
+        out: dict[str, Any] = {
+            "promoted": [], "launched": None, "retired": [],
+        }
+
+        # 1) Promote request-ready standbys into the routable decode set.
+        for info in list(reg.alive(role="standby")):
+            rid = info.replica_id
+            if rid in self._pending and not self.launcher.request_ready(rid):
+                continue
+            if not reg.set_role(rid, "decode"):
+                continue
+            if info.handle is not None:
+                # Keep the handle's self-reported role consistent so a
+                # registry re-register from info() doesn't demote it.
+                info.handle.role = "decode"
+            self._pending.discard(rid)
+            self._active.add(rid)
+            self._idle_ticks[rid] = 0
+            self.promoted_total += 1
+            obs.FLEET_SCALE_EVENTS.inc(direction="promote")
+            obs.flight.record("replica_promote", replica=rid)
+            log.info("standby %s promoted to decode", rid)
+            out["promoted"].append(rid)
+
+        # 2) Scale up on pressure.
+        shed = self._take_shed()
+        decode = reg.alive(role="decode")
+        depths = [c.queue_depth() for c in decode]
+        pressure = shed > 0 or (
+            self.queue_high is not None and depths
+            and min(depths) >= self.queue_high
+        )
+        fleet_size = len(self._pending) + len(self._active)
+        now = time.monotonic()
+        if (
+            pressure
+            and not self._pending
+            and fleet_size < self.max_replicas
+            and now - self._last_launch >= self.cooldown_s
+        ):
+            self._seq += 1
+            rid = f"scale-{self._seq}"
+            try:
+                self.launcher.launch(rid)
+            except Exception:  # noqa: BLE001 - launch is best-effort
+                log.exception("standby launch %s failed", rid)
+            else:
+                self._pending.add(rid)
+                self._last_launch = now
+                self.launched_total += 1
+                obs.FLEET_SCALE_EVENTS.inc(direction="up")
+                obs.flight.record(
+                    "replica_launch", replica=rid, shed_events=shed,
+                    min_queue_depth=min(depths) if depths else -1,
+                )
+                log.info(
+                    "scale-up: launching %s (shed=%d, min queue=%s)",
+                    rid, shed, min(depths) if depths else "n/a",
+                )
+                out["launched"] = rid
+
+        # 3) Scale down scaler-owned replicas that sat idle long enough.
+        #    Drain is graceful (sessions migrate), so this is safe even
+        #    if a request slips in between the check and the drain.
+        if not pressure:
+            by_id = {c.replica_id: c for c in decode}
+            for rid in list(self._active):
+                info = by_id.get(rid)
+                if info is None:
+                    self._active.discard(rid)
+                    self._idle_ticks.pop(rid, None)
+                    continue
+                if info.queue_depth() == 0 and not info.draining:
+                    self._idle_ticks[rid] = self._idle_ticks.get(rid, 0) + 1
+                else:
+                    self._idle_ticks[rid] = 0
+                if self._idle_ticks[rid] >= self.scale_down_after:
+                    try:
+                        self.router.drain(rid)
+                    except Exception:  # noqa: BLE001
+                        log.exception("scale-down drain of %s failed", rid)
+                        continue
+                    self.launcher.stop(rid)
+                    self._active.discard(rid)
+                    self._idle_ticks.pop(rid, None)
+                    self.retired_total += 1
+                    obs.FLEET_SCALE_EVENTS.inc(direction="down")
+                    obs.flight.record("replica_retire", replica=rid)
+                    log.info("scale-down: retired idle replica %s", rid)
+                    out["retired"].append(rid)
+        else:
+            for rid in self._idle_ticks:
+                self._idle_ticks[rid] = 0
+        return out
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            shed_pending = self._shed
+        return {
+            "max_replicas": self.max_replicas,
+            "pending": sorted(self._pending),
+            "active": sorted(self._active),
+            "shed_pending": shed_pending,
+            "launched_total": self.launched_total,
+            "promoted_total": self.promoted_total,
+            "retired_total": self.retired_total,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _run() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 - keep the loop alive
+                    log.exception("autoscaler tick failed")
+
+        self._thread = threading.Thread(
+            target=_run, name="autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for rid in list(self._pending) + list(self._active):
+            try:
+                self.launcher.stop(rid)
+            except Exception:  # noqa: BLE001
+                log.exception("launcher stop of %s failed", rid)

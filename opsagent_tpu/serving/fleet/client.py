@@ -166,6 +166,18 @@ class FleetMembership:
             except Exception:  # noqa: BLE001 - router briefly unreachable
                 self.last_heartbeat_ok = False
 
+    def promote(self, role: str = "decode") -> None:
+        """Accept a router-side role change (POST /fleet/promote on the
+        engine server). Heartbeats never carry role, so the only way the
+        promotion could revert is a full re-register — which now carries
+        the new role too."""
+        old, self.role = self.role, role
+        if old != role:
+            log.info(
+                "replica %s promoted: role %s -> %s",
+                self.replica_id, old, role,
+            )
+
     def stop(self, deregister: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:

@@ -244,6 +244,23 @@ class ReplicaRegistry:
         self._observe()
         return True
 
+    def set_role(self, replica_id: str, role: str) -> bool:
+        """Reassign a replica's role router-side. The autoscaler uses
+        this to promote ``standby`` replicas (request-ready but not yet
+        routable — ``route()`` only considers ``decode``) into the
+        decode set. Heartbeats never carry role, so the change sticks
+        until the replica fully re-registers."""
+        with self._lock:
+            info = self._replicas.get(replica_id)
+            if info is None:
+                return False
+            old = info.role
+            info.role = role
+        if old != role:
+            log.info("replica %s role %s -> %s", replica_id, old, role)
+        self._observe()
+        return True
+
     # -- reads -------------------------------------------------------------
     def get(self, replica_id: str) -> ReplicaInfo | None:
         with self._lock:
@@ -408,7 +425,7 @@ class ReplicaRegistry:
         for info in self.all():
             key = (info.role, "draining" if info.draining else "active")
             counts[key] = counts.get(key, 0) + 1
-        for role in ("decode", "prefill"):
+        for role in ("decode", "prefill", "standby"):
             for state in ("active", "draining"):
                 obs.FLEET_REPLICAS.set(
                     float(counts.get((role, state), 0)),

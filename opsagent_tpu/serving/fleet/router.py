@@ -134,9 +134,10 @@ def _trim_chunk_content(chunk: dict[str, Any], skip: int) -> dict[str, Any]:
 class LocalReplica:
     """In-process replica handle over a ServingStack (serving/api.py)."""
 
-    def __init__(self, stack: Any, replica_id: str):
+    def __init__(self, stack: Any, replica_id: str, role: str = "decode"):
         self.stack = stack
         self.replica_id = replica_id
+        self.role = role
 
     # routing data plane
     def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
@@ -162,7 +163,7 @@ class LocalReplica:
         return ReplicaInfo(
             replica_id=self.replica_id,
             model=self.stack.model_name,
-            role="decode",
+            role=self.role,
             capacity=int(eng.cfg.max_batch_size),
             page_size=int(eng.cfg.page_size),
             mesh={"tp": eng.cfg.tp, "sp": eng.cfg.sp, "ep": eng.cfg.ep},
@@ -425,11 +426,19 @@ class FleetRouter:
         self._pins: OrderedDict[str, str] = OrderedDict()     # session->rid
         self._owners: OrderedDict[str, str] = OrderedDict()   # req id->rid
         self._max_map = 8192
+        # Elastic scale-out (serving/fleet/autoscale.py). None = static
+        # fleet; set by run_router_server or a test harness. The router
+        # only feeds it shed pressure — all policy lives in the scaler.
+        self.autoscaler: Any = None
 
     # -- membership convenience -------------------------------------------
-    def add_local(self, stack: Any, replica_id: str) -> LocalReplica:
-        """Register an in-process ServingStack as a replica."""
-        handle = LocalReplica(stack, replica_id)
+    def add_local(
+        self, stack: Any, replica_id: str, role: str = "decode"
+    ) -> LocalReplica:
+        """Register an in-process ServingStack as a replica.
+        ``role="standby"`` keeps it out of the routable decode set until
+        the autoscaler promotes it."""
+        handle = LocalReplica(stack, replica_id, role=role)
         self.registry.register(handle.info())
         return handle
 
@@ -722,6 +731,10 @@ class FleetRouter:
             return
         retry_after = int(min(30, max(1, min(depths))))
         obs.FLEET_SHED.inc()
+        if self.autoscaler is not None:
+            # Shed = demand the fleet turned away: the strongest scale-up
+            # signal there is. Note it before the 429 leaves the building.
+            self.autoscaler.note_shed()
         obs.FLEET_REQUESTS.inc(outcome="shed")
         obs.flight.record(
             "request_shed", min_queue_depth=min(depths),
@@ -1240,6 +1253,8 @@ def build_router_app(router: FleetRouter):
             "queued": sum(r.queue_depth() for r in replicas),
             "shed_queue_depth": router.shed_queue_depth,
         }
+        if router.autoscaler is not None:
+            out["autoscale"] = router.autoscaler.snapshot()
         if faults.active():
             out["faults"] = faults.summary()
         return web.json_response(out)
@@ -1372,12 +1387,21 @@ def run_router_server(
     max_retries: int = DEFAULT_MAX_RETRIES,
     hedge_queue_depth: int | None = None,
     shed_queue_depth: int | None = None,
+    autoscale_snapshot: str = "",
+    autoscale_max_replicas: int = 4,
+    autoscale_port_base: int = 8400,
+    autoscale_cooldown_s: float = 30.0,
 ) -> None:
     """``opsagent serve-router``: the fleet front-end as a process. The
     tokenizer (HF path, or the hermetic byte tokenizer by default) must
     match the replicas' — affinity scores hash token chains, so a
     mismatched tokenizer silently zeroes every score (placement then
-    degrades to least-loaded, which is correct but cold)."""
+    degrades to least-loaded, which is correct but cold).
+
+    ``autoscale_snapshot`` (an ``opsagent snapshot create`` directory)
+    turns on elastic scale-out: shed pressure launches standby replicas
+    from the snapshot as local subprocesses and promotes them into the
+    decode set once request-ready."""
     from aiohttp import web
 
     from ..tokenizer import load_tokenizer
@@ -1393,10 +1417,31 @@ def run_router_server(
         hedge_queue_depth=hedge_queue_depth,
         shed_queue_depth=shed_queue_depth,
     )
+    scaler = None
+    if autoscale_snapshot:
+        from .autoscale import Autoscaler, SubprocessLauncher
+
+        launcher = SubprocessLauncher(
+            snapshot_path=autoscale_snapshot,
+            router_url=f"http://127.0.0.1:{port}",
+            port_base=autoscale_port_base,
+        )
+        scaler = Autoscaler(
+            router, launcher,
+            max_replicas=autoscale_max_replicas,
+            cooldown_s=autoscale_cooldown_s,
+        )
+        router.autoscaler = scaler
+        scaler.start()
     app = build_router_app(router)
 
     async def _announce(_) -> None:
         log.info("fleet router listening on %s:%d", host, port)
 
+    async def _shutdown(_) -> None:
+        if scaler is not None:
+            scaler.stop()
+
     app.on_startup.append(_announce)
+    app.on_shutdown.append(_shutdown)
     web.run_app(app, host=host, port=port, print=None)

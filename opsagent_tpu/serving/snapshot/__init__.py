@@ -1,0 +1,32 @@
+"""Engine snapshot/restore: cold start as a subsystem (ROADMAP item 4).
+
+A snapshot packages a fully-warmed engine — weights in device layout,
+the persistent XLA compile cache, and the paged-KV allocation plan —
+into a directory artifact that a new replica restores from in a
+fraction of fresh-init time (``Engine.from_snapshot`` / ``serve-engine
+--restore-snapshot``), which is what makes the fleet autoscaler's
+standby launches (serving/fleet/autoscale.py) fast enough to absorb a
+traffic spike instead of shedding it.
+
+``manifest`` is jax-free on purpose: ``opsagent snapshot verify`` runs
+on any CI box. ``writer``/``restore`` import jax and are pulled in
+lazily by the Engine methods.
+"""
+
+from .manifest import (
+    FORMAT_VERSION,
+    MANIFEST_NAME,
+    SnapshotError,
+    fingerprint,
+    read_manifest,
+    verify_snapshot,
+)
+
+__all__ = [
+    "FORMAT_VERSION",
+    "MANIFEST_NAME",
+    "SnapshotError",
+    "fingerprint",
+    "read_manifest",
+    "verify_snapshot",
+]

@@ -1,0 +1,230 @@
+"""Snapshot restore: mmap'd weights -> device, pre-seeded compile cache.
+
+The restore path is the cold-start fix (ROADMAP item 4): instead of the
+checkpoint-parse -> host-layout -> quantize -> device_put pipeline in
+``models/loader.py``, each weight leaf file is ``np.memmap``'d read-only
+and handed straight to ``shard_params`` (one ``jax.device_put`` per leaf
+with the recorded sharding — the OS pages bytes in as the transfer
+consumes them). The spec tree is NOT serialized: it is re-derived from
+the manifest's configs via ``llama.param_specs`` (+ ``quantize_specs``),
+and its deterministic flatten order is the leaf-file contract — checked
+leaf-by-leaf against the manifest's recorded key paths before anything
+touches a device.
+
+Mismatched snapshots are refused up front with the offending field
+spelled out (fingerprint, device count, leaf order), because the
+failure modes past this point are shape errors deep in ``shard_params``
+or silent recompiles that void the zero-post-warmup-compiles invariant.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import obs
+from ...models.config import (
+    MLAConfig,
+    MoEConfig,
+    ModelConfig,
+    RopeScalingConfig,
+)
+from ...utils.logger import get_logger
+from ..engine import Engine, EngineConfig, enable_compilation_cache
+from .manifest import (
+    COMPILE_CACHE_DIR,
+    SnapshotError,
+    fingerprint,
+    read_manifest,
+)
+from .writer import spec_leaf_paths
+
+log = get_logger("snapshot")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """numpy dtype from a manifest dtype name. np.dtype("bfloat16")
+    raises TypeError — the ml_dtypes-backed names resolve through jnp."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        return np.dtype(getattr(jnp, name))
+
+
+def model_config_from_manifest(man: dict[str, Any]) -> ModelConfig:
+    m = dict(man["model"])
+    moe = m.pop("moe", None)
+    mla = m.pop("mla", None)
+    rs = m.pop("rope_scaling", None)
+    return ModelConfig(
+        **m,
+        moe=MoEConfig(**moe) if moe else None,
+        mla=MLAConfig(**mla) if mla else None,
+        rope_scaling=RopeScalingConfig(**rs) if rs else None,
+    )
+
+
+def engine_config_from_manifest(man: dict[str, Any]) -> EngineConfig:
+    e = dict(man["engine"])
+    e["dtype"] = _np_dtype(e["dtype"]).type
+    e["prefill_buckets"] = tuple(e["prefill_buckets"])
+    e["mixed_buckets"] = tuple(e["mixed_buckets"])
+    return EngineConfig(**e, warmup=False)
+
+
+def preseed_compile_cache(path: str, active_dir: str | None) -> int:
+    """Copy the snapshot's compile-cache entries into the active cache
+    directory (never overwriting — entries already present belong to
+    this host). Returns entries copied. The snapshot stays read-only."""
+    if not active_dir:
+        return 0
+    src = os.path.join(path, COMPILE_CACHE_DIR)
+    if not os.path.isdir(src):
+        return 0
+    copied = 0
+    for root, _dirs, files in os.walk(src):
+        rel = os.path.relpath(root, src)
+        dst_root = os.path.join(active_dir, rel) if rel != "." else active_dir
+        os.makedirs(dst_root, exist_ok=True)
+        for f in files:
+            dst = os.path.join(dst_root, f)
+            if os.path.exists(dst):
+                continue
+            shutil.copy2(os.path.join(root, f), dst)
+            copied += 1
+    return copied
+
+
+def restore_params(
+    man: dict[str, Any], path: str, model_cfg: ModelConfig,
+    cfg: EngineConfig,
+) -> Any:
+    """Host params pytree of read-only memmaps, assembled by unflattening
+    the leaf files through the re-derived spec tree."""
+    from jax.sharding import PartitionSpec
+
+    from ...models import llama
+
+    specs = llama.param_specs(model_cfg)
+    if cfg.quantize:
+        from ...models.quant import quantize_specs
+
+        specs = quantize_specs(specs, mode=cfg.quantize)
+    _, treedef = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    recs = man["leaves"]
+    if treedef.num_leaves != len(recs):
+        raise SnapshotError(
+            f"snapshot has {len(recs)} weight leaves but this build's "
+            f"param spec tree has {treedef.num_leaves} — param_specs "
+            "changed since the snapshot was written"
+        )
+    leaves = []
+    for rec in recs:
+        fpath = os.path.join(path, rec["file"])
+        if not os.path.exists(fpath):
+            raise SnapshotError(f"missing weight leaf file {rec['file']}")
+        size = os.path.getsize(fpath)
+        if size != rec["nbytes"]:
+            raise SnapshotError(
+                f"{rec['file']}: {size} bytes on disk, manifest says "
+                f"{rec['nbytes']} (truncated or corrupt snapshot)"
+            )
+        leaves.append(np.memmap(
+            fpath, dtype=_np_dtype(rec["dtype"]), mode="r",
+            shape=tuple(rec["shape"]),
+        ))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def restore_engine(
+    path: str,
+    warmup: bool | str | None = None,
+    tokenizer: Any = None,
+) -> Engine:
+    """``Engine.from_snapshot`` body. ``warmup``: None/False = no warmup
+    sweep (tests), True = "full", or a WARMUP_LEVELS name. With the
+    snapshot's compile cache pre-seeded the sweep is a cache-hit replay,
+    which is exactly what makes restore request-ready fast."""
+    t0 = time.perf_counter()
+    man = read_manifest(path)
+    fp = fingerprint(man["model"], man["engine"])
+    if fp != man["fingerprint"]:
+        obs.SNAPSHOT_OPS.inc(op="refused")
+        raise SnapshotError(
+            f"refusing restore from {path}: config fingerprint {fp} != "
+            f"manifest fingerprint {man['fingerprint']} (the manifest's "
+            "model/engine configs were edited after the snapshot was "
+            "written, or the file is corrupt)"
+        )
+    n_dev = len(jax.devices())
+    want_dev = int(man["jax"]["n_devices"])
+    if want_dev != n_dev:
+        obs.SNAPSHOT_OPS.inc(op="refused")
+        raise SnapshotError(
+            f"refusing restore from {path}: snapshot was written on "
+            f"{want_dev} devices, this host has {n_dev} — shardings "
+            "would not match"
+        )
+    backend = jax.default_backend()
+    if man["jax"]["backend"] != backend:
+        log.warning(
+            "snapshot %s was written on backend %s, restoring on %s: "
+            "weights restore fine but the packaged compile cache will "
+            "not hit", path, man["jax"]["backend"], backend,
+        )
+
+    # Pre-seed BEFORE the engine exists: warmup compiles (and therefore
+    # cache lookups) happen inside Engine.__init__ when warmup is on.
+    active_cache = enable_compilation_cache()
+    preseeded = preseed_compile_cache(path, active_cache)
+
+    model_cfg = model_config_from_manifest(man)
+    cfg = engine_config_from_manifest(man)
+    expect = [rec["path"] for rec in man["leaves"]]
+    got = spec_leaf_paths(model_cfg, cfg.quantize)
+    if got != expect:
+        obs.SNAPSHOT_OPS.inc(op="refused")
+        drift = next(
+            (i for i, (a, b) in enumerate(zip(expect, got)) if a != b),
+            min(len(expect), len(got)),
+        )
+        raise SnapshotError(
+            f"refusing restore from {path}: weight leaf order drifted "
+            f"at index {drift} (snapshot: "
+            f"{expect[drift] if drift < len(expect) else '<end>'}, this "
+            f"build: {got[drift] if drift < len(got) else '<end>'})"
+        )
+
+    params = restore_params(man, path, model_cfg, cfg)
+    eng = Engine(
+        cfg, model_cfg=model_cfg, params=params, tokenizer=tokenizer,
+        params_quantized=bool(cfg.quantize),
+    )
+    eng.init_stats["restore_source"] = os.path.abspath(path)
+    eng.init_stats["snapshot_fingerprint"] = man["fingerprint"]
+    eng.init_stats["compile_cache_preseeded"] = preseeded
+    if warmup:
+        eng.warmup("full" if warmup is True else str(warmup))
+
+    dt = time.perf_counter() - t0
+    obs.SNAPSHOT_OPS.inc(op="restore")
+    obs.SNAPSHOT_RESTORE_SECONDS.observe(dt)
+    obs.flight.record(
+        "snapshot_restore", path=path, seconds=round(dt, 3),
+        leaves=len(man["leaves"]), compile_cache_preseeded=preseeded,
+        fingerprint=man["fingerprint"],
+    )
+    log.info(
+        "engine restored from %s in %.1f s (%d leaves mmap'd, %d "
+        "compile-cache entries pre-seeded) [fp=%s]",
+        path, dt, len(man["leaves"]), preseeded, man["fingerprint"],
+    )
+    return eng

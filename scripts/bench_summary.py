@@ -214,6 +214,19 @@ def terminal_summary(paths: list[str]) -> int:
             f"{e.get('off_p99_ttft_ms', 0)} ms (clean); outputs "
             f"identical: {e.get('outputs_identical')}"
         )
+    coldst = [d for d in tpu
+              if d["metric"].startswith("cold_start_request_ready")]
+    if coldst:
+        d = coldst[-1]
+        e = d.get("extra", {})
+        print(
+            f"cold-start A/B: request-ready "
+            f"{e.get('restore_request_ready_s', d['value'])} s (snapshot "
+            f"restore) vs {e.get('fresh_request_ready_s', 0)} s (fresh "
+            f"init) = {e.get('speedup_ratio', 0)}x; outputs identical: "
+            f"{e.get('outputs_identical')}; post-warmup compiles on "
+            f"restore: {e.get('post_warmup_compiles')}"
+        )
     agent = [d for d in tpu if d["metric"].startswith("agent_turn_ttft")]
     if agent:
         best_a = min(agent, key=lambda d: d["value"])
