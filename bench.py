@@ -479,6 +479,16 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         230, "fleet-chaos",
     ) if on_tpu else None
+    # Fleet-global KV A/B: page directory + peer fault-in ON vs OFF over
+    # the same forced-misroute session workload. The ON phase must land
+    # second turns on a non-owning replica (and a freshly promoted
+    # standby) through the wire-restore path with byte-identical greedy
+    # output; the reported delta is re-prefill work avoided.
+    rfgkv = stage(
+        {"OPSAGENT_BENCH_MODE": "fleet-global-kv",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        240, "fleet-global-kv",
+    ) if on_tpu else None
     # The literal north-star metric (BASELINE: p50 TTFT per tool-call
     # turn): multi-turn ReAct-shaped sessions with the prefix cache on.
     # Reports ms, not tok/s — never a headline candidate; folded into
@@ -616,6 +626,25 @@ def run_orchestrated() -> None:
         extra["fleet_chaos_outputs_identical"] = che.get(
             "outputs_identical"
         )
+    if rfgkv is not None:
+        ge = rfgkv.get("extra", {})
+        extra["fleet_global_kv_remote_hit_pages"] = ge.get(
+            "remote_hit_pages"
+        )
+        extra["fleet_global_kv_reprefill_avoided_tokens"] = ge.get(
+            "reprefill_avoided_tokens"
+        )
+        extra["fleet_global_kv_outputs_identical"] = ge.get(
+            "outputs_identical"
+        )
+        extra["fleet_global_kv_standby_identical"] = ge.get(
+            "standby_identical"
+        )
+        extra["fleet_global_kv_p50_moved_ms"] = ge.get("p50_moved_ms")
+        extra["fleet_global_kv_off_p50_moved_ms"] = ge.get(
+            "off_p50_moved_ms"
+        )
+        extra["fleet_global_kv_fallbacks"] = ge.get("fallbacks")
     if ragent is not None:
         ae = ragent.get("extra", {})
         extra["agent_turn_p50_ttft_ms"] = ragent["value"]
@@ -656,7 +685,7 @@ def run_orchestrated() -> None:
     # printed, so the verdict can never eat a result line.
     exit_if_perf_regression([
         r1, r8b, r8b4, r8bkv, r8b4kv, rsess, rsessmix, rsessasync,
-        rsessoff, rfleet, rchaos, ragent, rdma, rdmakv, rcold,
+        rsessoff, rfleet, rchaos, rfgkv, ragent, rdma, rdmakv, rcold,
         rcoldstart, rspec,
     ])
 
@@ -700,7 +729,7 @@ def run_single() -> None:
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "fleet-affinity", "fleet-chaos",
-                "cold-start"):
+                "fleet-global-kv", "cold-start"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -775,7 +804,7 @@ def run_single() -> None:
         mixed_batching=mixed_on,
         async_depth=async_depth,
         offload=(mode in ("sessions-offload", "fleet-affinity",
-                          "fleet-chaos")),
+                          "fleet-chaos", "fleet-global-kv")),
     )
     # Fail fast on undersized sweep points: OutOfPages mid-window would
     # force-finish sequences ('length') and quietly deflate the metric.
@@ -814,7 +843,8 @@ def run_single() -> None:
     # -> pipelined decode), so it shares that warmup level.
     t0 = time.perf_counter()
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
-                "sessions-async", "fleet-affinity", "fleet-chaos"):
+                "sessions-async", "fleet-affinity", "fleet-chaos",
+                "fleet-global-kv"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -847,6 +877,10 @@ def run_single() -> None:
     if mode == "fleet-chaos":
         run_fleet_chaos(eng, cfg, model, batch, steps, prompt_len,
                         platform, n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "fleet-global-kv":
+        run_fleet_global_kv(eng, cfg, model, batch, steps, prompt_len,
+                            platform, n_chips, quantize, init_s, warmup_s)
         return
     if mode == "agent":
         # turns/gen_tokens are THE values the page-budget guard above was
@@ -1655,6 +1689,214 @@ def run_fleet_affinity(eng, cfg, model, batch, steps, prompt_len, platform,
             "chips": n_chips,
             "platform": platform,
             "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": snap,
+            "attribution": attribution_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    log_perf_table()
+    for s in stacks:
+        s.close()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_fleet_global_kv(eng, cfg, model, batch, steps, prompt_len,
+                        platform, n_chips, quantize, init_s,
+                        warmup_s) -> None:
+    """The fleet-global-KV A/B stage (serving/fleet/pagestore): the page
+    directory + peer fault-in path ON vs OFF (legacy eager-push
+    migration). Per session: turn 1 lands on replica A (the owner), the
+    second turn is FORCED onto replica B with zero affinity — with the
+    directory on, B faults the chain in peer-to-peer and restores over
+    the wire; the same turn is then replayed on never-moved A and the
+    greedy outputs must be byte-identical. The ON phase also promotes a
+    standby replica mid-run and forces a third turn onto it (the
+    scale-up story: a freshly promoted replica is instantly useful for
+    EXISTING sessions). Decision numbers per phase: fleet-summed
+    re-prefill-avoided tokens, pagestore remote-hit pages, p50 moved-
+    turn latency, and the identical-output flags."""
+    from dataclasses import replace as dc_replace
+
+    from opsagent_tpu import obs as obs_mod
+    from opsagent_tpu.serving.api import ServingStack
+    from opsagent_tpu.serving.engine import Engine
+    from opsagent_tpu.serving.fleet.router import FleetRouter
+
+    n_replicas = int(os.environ.get("OPSAGENT_BENCH_REPLICAS", "2"))
+    gen_tokens = max(16, steps // 8)
+    engines = [eng]
+    for _ in range(1, n_replicas + 1):   # +1: the standby replica
+        e = Engine(dc_replace(cfg, seed=cfg.seed))
+        e.warmup("sessions")
+        engines.append(e)
+    stacks = [ServingStack(e) for e in engines]
+
+    def fleet_avoided() -> int:
+        return sum(
+            e.offload.restored_tokens for e in engines
+            if e.offload is not None
+        )
+
+    def drive(router, seed_base: int, standby_id: str | None) -> dict:
+        moved_ms: list[float] = []
+        errors: list[str] = []
+        identical = True
+        standby_identical = True
+        for sid in range(batch):
+            rng = np.random.default_rng(seed_base + sid)
+            words = [
+                f"w{rng.integers(0, 9999)}" for _ in range(prompt_len // 2)
+            ]
+            messages = [
+                {"role": "system", "content": "fleet global kv bench"},
+                {"role": "user", "content": " ".join(words)},
+            ]
+
+            def turn(msgs, force):
+                resp = router.complete(
+                    {
+                        "messages": msgs, "max_tokens": gen_tokens,
+                        "temperature": 0.0,
+                    },
+                    force_replica=force,
+                )
+                return resp["choices"][0]["message"]["content"] or ""
+
+            try:
+                # Turn 1 establishes ownership on replica 0.
+                t1 = turn(messages, "bench-r0")
+                messages += [
+                    {"role": "assistant", "content": t1},
+                    {"role": "user", "content": f"continue {sid}"},
+                ]
+                # Turn 2 forced onto a NON-owner: the directory-on
+                # phase faults the chain in; both phases must match the
+                # never-moved replay on replica 0.
+                t0 = time.perf_counter()
+                moved = turn(messages, "bench-r1")
+                moved_ms.append((time.perf_counter() - t0) * 1e3)
+                stayed = turn(messages, "bench-r0")
+                if moved != stayed:
+                    identical = False
+                if standby_id is not None:
+                    # Turn 3 onto the freshly promoted standby.
+                    messages += [
+                        {"role": "assistant", "content": stayed},
+                        {"role": "user", "content": "and then?"},
+                    ]
+                    t3_standby = turn(messages, standby_id)
+                    t3_owner = turn(messages, "bench-r0")
+                    if t3_standby != t3_owner:
+                        standby_identical = False
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"session {sid}: {e}")
+        return {
+            "moved_ms": moved_ms,
+            "errors": errors,
+            "identical": identical,
+            "standby_identical": standby_identical,
+        }
+
+    def pagestore_counters() -> dict:
+        snap = metrics_snapshot()
+        return {
+            "remote_hits": snap.get(
+                "opsagent_pagestore_remote_hits_total", 0.0
+            ),
+            "fetch_bytes": snap.get(
+                "opsagent_pagestore_fetch_bytes_total", 0.0
+            ),
+            "stale": snap.get(
+                "opsagent_pagestore_stale_entries_total", 0.0
+            ),
+            "fallbacks": sum(
+                v for k, v in snap.items()
+                if k.startswith("opsagent_pagestore_fallbacks_total")
+            ),
+        }
+
+    phases: dict[str, dict] = {}
+    for tag, flag, seed in (("on", True, 21000), ("off", False, 25000)):
+        router = FleetRouter(sticky=False, pagestore=flag)
+        for i, stack in enumerate(stacks[: n_replicas]):
+            router.add_local(stack, f"bench-r{i}")
+        standby_id = None
+        if flag:
+            # The scale-up leg: register the spare as a standby, promote
+            # it into the decode set mid-phase — its first-ever turns
+            # must restore existing sessions' chains over the wire.
+            standby_id = "bench-standby"
+            router.add_local(stacks[n_replicas], standby_id,
+                             role="standby")
+            router.registry.set_role(standby_id, "decode")
+        avoided0 = fleet_avoided()
+        ps0 = pagestore_counters()
+        compiles0 = obs_mod.POST_WARMUP_COMPILES.value()
+        t0 = time.perf_counter()
+        phases[tag] = drive(router, seed, standby_id)
+        r = phases[tag]
+        r["wall"] = time.perf_counter() - t0
+        r["reprefill_avoided_tokens"] = fleet_avoided() - avoided0
+        ps1 = pagestore_counters()
+        r["pagestore"] = {
+            k: ps1[k] - ps0[k] for k in ps1
+        }
+        r["post_compiles"] = (
+            obs_mod.POST_WARMUP_COMPILES.value() - compiles0
+        )
+        r["directory"] = router.registry.directory.stats()
+        r["p50_moved_ms"] = (
+            float(np.median(r["moved_ms"])) if r["moved_ms"] else 0.0
+        )
+        log(f"bench[fleet-global-kv/{tag}]: {batch} sessions moved onto "
+            f"non-owners; identical={r['identical']} "
+            f"standby_identical={r['standby_identical']} "
+            f"remote_hit_pages={r['pagestore']['remote_hits']:.0f} "
+            f"re-prefill avoided {r['reprefill_avoided_tokens']} tok; "
+            f"p50 moved-turn {r['p50_moved_ms']:.0f} ms; "
+            f"post-warmup compiles {r['post_compiles']:.0f}; "
+            f"errors={len(r['errors'])}")
+    on, off = phases["on"], phases["off"]
+    # Remote hits per phase: the ON phase restores over the wire
+    # (directory + fault-in); the OFF phase may still avoid re-prefill
+    # via the legacy eager push, but never through the page store.
+    total_tokens = batch * gen_tokens * 4  # 2 turns + replay legs, approx
+    tok_s_chip = total_tokens / max(1e-9, on["wall"]) / n_chips
+    snap = metrics_snapshot()
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": (
+            f"fleet_global_kv[{model}{qtag},N={batch},R={n_replicas}+1,"
+            f"{platform}]"
+        ),
+        "value": round(tok_s_chip, 1),
+        "unit": "tok/s/chip",
+        "extra": {
+            "replicas": n_replicas,
+            "standby": 1,
+            "sessions": batch,
+            "remote_hit_pages": on["pagestore"]["remote_hits"],
+            "fetch_bytes": on["pagestore"]["fetch_bytes"],
+            "stale_entries": on["pagestore"]["stale"],
+            "fallbacks": on["pagestore"]["fallbacks"],
+            "outputs_identical": on["identical"],
+            "standby_identical": on["standby_identical"],
+            "off_outputs_identical": off["identical"],
+            "reprefill_avoided_tokens": on["reprefill_avoided_tokens"],
+            "off_reprefill_avoided_tokens": off[
+                "reprefill_avoided_tokens"
+            ],
+            "off_remote_hit_pages": off["pagestore"]["remote_hits"],
+            "p50_moved_ms": round(on["p50_moved_ms"], 1),
+            "off_p50_moved_ms": round(off["p50_moved_ms"], 1),
+            "post_compiles": on["post_compiles"],
+            "directory": on["directory"],
+            "errors": len(on["errors"]) + len(off["errors"]),
+            "error_detail": (on["errors"] + off["errors"])[:4],
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
             "metrics": snap,
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),

@@ -146,6 +146,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="print the raw timeline JSON instead of the gantt",
     )
 
+    fkv = sub.add_parser(
+        "fleet-kv",
+        help="dump the fleet router's global KV page directory (which "
+             "replica owns which prefix chains, tier footprints, "
+             "advertisement staleness)",
+    )
+    fkv.add_argument(
+        "--url", default="http://127.0.0.1:8090",
+        help="fleet router base URL; fetches GET /api/fleet/directory",
+    )
+    fkv.add_argument(
+        "--limit", type=int, default=256,
+        help="max chain rows to fetch (the directory can hold thousands)",
+    )
+    fkv.add_argument(
+        "--json", action="store_true", default=False,
+        help="print the raw directory JSON instead of the table",
+    )
+
     se = sub.add_parser("serve-engine", help="run the TPU serving engine (OpenAI-compatible)")
     se.add_argument("--port", type=int, default=8000)
     se.add_argument("--host", default="0.0.0.0")
@@ -474,6 +493,61 @@ def main(argv: list[str] | None = None) -> int:
             print(_json.dumps(tl_data, indent=2))
         else:
             print(obs_timeline.render_gantt(tl_data, width=args.width))
+        return 0
+
+    if args.command == "fleet-kv":
+        import json as _json
+        import urllib.request
+
+        url = (
+            args.url.rstrip("/")
+            + f"/api/fleet/directory?limit={args.limit}"
+        )
+        try:
+            with urllib.request.urlopen(  # noqa: S310 - operator URL
+                url, timeout=10
+            ) as resp:
+                snap = _json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 - CLI surface
+            print(f"directory fetch failed: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(_json.dumps(snap, indent=2))
+            return 0
+        st = snap.get("stats", {})
+        print(
+            f"directory: {st.get('chains', 0)} chains over "
+            f"{st.get('replicas', 0)} replicas | lookups "
+            f"{st.get('lookups', 0)} (hits {st.get('hits', 0)}, misses "
+            f"{st.get('misses', 0)}), stale evictions "
+            f"{st.get('stale_evictions', 0)}"
+        )
+        replicas = snap.get("replicas", [])
+        if replicas:
+            print(f"\n{'replica':<16} {'role':<8} {'state':<9} "
+                  f"{'digests':>8} {'pool pages':>11} {'hb age':>8}")
+            for r in replicas:
+                digests = str(r.get("digest_count", 0))
+                if r.get("digest_truncated"):
+                    digests += "+"
+                print(
+                    f"{r.get('id', '?'):<16} {r.get('role', '?'):<8} "
+                    f"{r.get('state', '?'):<9} {digests:>8} "
+                    f"{r.get('host_pool_pages', 0):>11} "
+                    f"{r.get('heartbeat_age_s', 0):>7.1f}s"
+                )
+        rows = snap.get("rows", [])
+        if rows:
+            print(f"\n{'chain':<14} {'owners (freshest first)'}")
+            for row in rows:
+                owners = ", ".join(
+                    f"{o.get('id', '?')} ({o.get('age_s', 0):.1f}s)"
+                    for o in row.get("owners", [])
+                )
+                print(f"{row.get('chain', '?')[:12]:<14} {owners}")
+            if snap.get("truncated"):
+                print(f"... truncated at {len(rows)} rows "
+                      f"(raise --limit for more)")
         return 0
 
     if args.command == "server":

@@ -324,6 +324,42 @@ FLEET_KV_IMPORT_REJECTS = _reg.counter(
     "structure mismatch); the receiver re-prefills instead",
 )
 
+# -- fleet-global KV: page directory + peer-to-peer fault-in ------------------
+PAGESTORE_LOOKUPS = _reg.counter(
+    "opsagent_pagestore_lookups_total",
+    "Chain-key lookups against the fleet page directory at admission "
+    "(one per missing page-aligned prefix chain)",
+)
+PAGESTORE_REMOTE_HITS = _reg.counter(
+    "opsagent_pagestore_remote_hits_total",
+    "KV page chains faulted in peer-to-peer and landed in the local "
+    "host pool (the remote tier between host-pool-hit and re-prefill)",
+)
+PAGESTORE_FETCH_BYTES = _reg.counter(
+    "opsagent_pagestore_fetch_bytes_total",
+    "Bytes of KV page payload fetched peer-to-peer by the page store",
+)
+PAGESTORE_FETCH_SECONDS = _reg.histogram(
+    "opsagent_pagestore_fetch_seconds",
+    "Wall time of one admission page fault-in (directory lookup "
+    "excluded; fetch + verify + host-pool landing)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0),
+)
+PAGESTORE_STALE_ENTRIES = _reg.counter(
+    "opsagent_pagestore_stale_entries_total",
+    "Directory rows evicted because the advertised peer could not "
+    "produce the chain (LRU-evicted between heartbeats, 404, or "
+    "digest reject)",
+)
+PAGESTORE_FALLBACKS = _reg.counter(
+    "opsagent_pagestore_fallbacks_total",
+    "Admissions that degraded to local re-prefill after a page-store "
+    "attempt, by reason (no_owner / miss / timeout / error / "
+    "lookup_error)",
+    labelnames=("reason",),
+)
+
 # -- cold start: engine snapshot/restore + elastic autoscaling ----------------
 SNAPSHOT_OPS = _reg.counter(
     "opsagent_snapshot_ops_total",

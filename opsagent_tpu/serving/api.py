@@ -1001,31 +1001,71 @@ def build_engine_app(stack: ServingStack, membership=None):
         return web.json_response({"parked_tokens": parked})
 
     async def fleet_kv_export(request: web.Request) -> web.Response:
+        # Two body forms share the wire format:
+        #   {"tokens": [...], "park": true}        single chain (migration)
+        #   {"chains": [{"tokens": [...], "start_page": N}, ...],
+        #    "park": false}                        batched (page fault-in)
+        # park=True frees the chain's HBM pages after copying (the sender
+        # is handing the session off); park=False replicates trie pages
+        # into the host pool non-destructively — a peer fault-in must not
+        # cost this replica its own cache.
         try:
             body = await request.json()
-            tokens = [int(t) for t in body.get("tokens") or []]
-        except (json.JSONDecodeError, TypeError, ValueError):
+            chains = body.get("chains")
+            if chains is None:
+                chains = [{
+                    "tokens": body.get("tokens") or [],
+                    "start_page": 0,
+                }]
+            reqs = [
+                (
+                    [int(t) for t in c.get("tokens") or []],
+                    max(0, int(c.get("start_page", 0))),
+                )
+                for c in chains
+            ]
+        except (json.JSONDecodeError, TypeError, ValueError,
+                AttributeError):
             return web.json_response(
                 {"error": {"message": "tokens must be an int list"}},
                 status=400,
             )
+        batched = body.get("chains") is not None
         park = bool(body.get("park", True))
         eng = stack.engine
         if getattr(eng, "offload", None) is None:
-            return web.json_response({"pages": [], "offload": False})
+            empty = {"pages": [], "offload": False}
+            if batched:
+                empty = {
+                    "results": [{"pages": []} for _ in reqs],
+                    "offload": False,
+                }
+            return web.json_response(empty)
         from .fleet.transfer import pack_entries
 
         loop = asyncio.get_running_loop()
 
         def _export():
-            if park:
-                eng.park_chain(tokens)
-            eng.offload_flush()
-            return pack_entries(eng.offload.pool.entries_for(tokens))
+            out = []
+            for tokens, start_page in reqs:
+                if park:
+                    eng.park_chain(tokens)
+                    eng.offload_flush()
+                else:
+                    eng.replicate_chain(tokens)
+                out.append(pack_entries(
+                    eng.offload.pool.match(tokens, start_page=start_page)
+                ))
+            return out
 
-        pages = await loop.run_in_executor(None, _export)
+        results = await loop.run_in_executor(None, _export)
+        if batched:
+            return web.json_response({
+                "results": [{"pages": p} for p in results],
+                "page_size": int(eng.cfg.page_size),
+            })
         return web.json_response({
-            "pages": pages, "page_size": int(eng.cfg.page_size),
+            "pages": results[0], "page_size": int(eng.cfg.page_size),
         })
 
     async def fleet_kv_import(request: web.Request) -> web.Response:
@@ -1186,6 +1226,14 @@ def run_engine_server(
             replica_id=replica_id,
             role=replica_role,
         )
+        if getattr(engine, "offload", None) is not None:
+            # Fleet-global KV: admission misses consult the router's
+            # page directory and fault chains in peer-to-peer.
+            from .fleet.pagestore import http_client
+
+            engine.pagestore = http_client(
+                join_fleet, membership.replica_id, engine
+            )
     app = build_engine_app(stack, membership=membership)
     # Continuous SLO evaluation (GET /api/slo serves the same watchdog):
     # keeps the throughput rate window warm and logs breach transitions

@@ -18,6 +18,7 @@ points (SURVEY.md section 7):
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -477,6 +478,12 @@ class Engine:
             )
             self.alloc.attach_host_pool(self.offload.pool)
             self.alloc.set_spill(self._spill_page)
+        # Fleet-global KV fault-in client (fleet/pagestore.py). Wired by
+        # the router (in-process) or run_engine_server (--join-fleet);
+        # None = the peer-fetch tier is off and admission behaves as
+        # before (trie -> host pool -> re-prefill).
+        self.pagestore = None
+        self._digests_truncated = False
         self.sequences: dict[int, Sequence] = {}
         self._evictions_seen = 0  # delta-sync base for the obs counter
         self._sample_key = jax.random.PRNGKey(cfg.seed + 1)
@@ -1151,6 +1158,14 @@ class Engine:
             sampling = _dc_replace(
                 sampling, max_tokens=self.model_cfg.max_position - n
             )
+        # Fleet-global KV tier: if the prompt misses locally (trie AND
+        # host pool), fault the missing chain in from a peer BEFORE
+        # taking the engine lock — the fetch is network I/O and must not
+        # stall running decode. Landed pages are host-pool entries under
+        # the same chain keys, so the locked restore below picks them up
+        # through the unchanged _restore_from_host path. Never raises;
+        # a miss/failure just means the prefill loop covers the tokens.
+        faulted_pages = self.fault_in_prefix(prompt_ids)
         with self.lock:
             if self.offload is not None:
                 # Land pending spills first: a page parked during the
@@ -1165,6 +1180,10 @@ class Engine:
             restored = self._restore_from_host(
                 seq_id, prompt_ids, n, len(prefix_pages), matched
             )
+            if faulted_pages and restored and self.offload is not None:
+                self.offload.note_remote_hit(
+                    min(restored, faulted_pages * self.cfg.page_size)
+                )
             if expect_restore and matched + restored < (
                 (n - 1) // self.cfg.page_size
             ) * self.cfg.page_size:
@@ -2767,23 +2786,99 @@ class Engine:
         with self.lock:
             return self.offload.flush()
 
-    def prefix_digests(self, cap: int = 8192) -> list[str]:
+    def prefix_digests(self, cap: int | None = None) -> list[str]:
         """Compact prefix digest of this replica's cached state for the
         fleet registry: hex chain keys (offload/pool.chain_key_hex) of
         every HBM-trie-resident page chain plus every host-pool page. The
         router scores a prompt's longest-cached-prefix affinity against
-        this set; ``cap`` bounds the advertisement (newest trie content
-        wins by iteration order — over-cap replicas just under-advertise,
-        which only costs affinity hits, never correctness)."""
+        this set and indexes it into the fleet page directory; ``cap``
+        bounds the advertisement (explicit arg > env
+        ``OPSAGENT_FLEET_DIGEST_CAP`` > 4096; newest content wins by
+        iteration order — over-cap replicas just under-advertise, which
+        only costs affinity/fault-in hits, never correctness). Sets
+        ``digests_truncated()`` so the registry snapshot can surface
+        replicas whose advertisement is clipped."""
         from .offload.pool import chain_key_hex
 
+        if cap is None:
+            try:
+                cap = int(os.environ.get("OPSAGENT_FLEET_DIGEST_CAP", ""))
+            except ValueError:
+                cap = 0
+            if cap <= 0:
+                cap = 4096
         with self.lock:
             keys = [chain_key_hex(c) for c in self.alloc.trie_chains()]
         if self.offload is not None:
             keys.extend(self.offload.pool.digests())
-        if len(keys) > cap:
+        self._digests_truncated = len(keys) > cap
+        if self._digests_truncated:
             keys = keys[-cap:]
         return keys
+
+    def digests_truncated(self) -> bool:
+        """Whether the last ``prefix_digests`` advertisement was clipped
+        by the digest cap (registry snapshot: ``digest_truncated``)."""
+        return self._digests_truncated
+
+    def fault_in_prefix(self, prompt_ids: list[int]) -> int:
+        """Fleet-global KV fault-in (tier 3): when the usable prefix of
+        ``prompt_ids`` misses the HBM trie AND the host pool, ask the
+        fleet page directory who owns the missing chain and fetch it
+        peer-to-peer into the host pool (fleet/pagestore.py), so the
+        admission's ordinary host restore lands it. Probes under the
+        engine lock (cheap reads), fetches OUTSIDE it. Returns pages
+        landed; 0 on any miss/failure — never raises into admission."""
+        if self.pagestore is None or self.offload is None:
+            return 0
+        try:
+            usable = prompt_ids[: len(prompt_ids) - 1]
+            total = len(usable) // self.cfg.page_size
+            if total == 0:
+                return 0
+            with self.lock:
+                self.offload.flush()
+                matched = len(self.alloc.match_prefix(usable))
+            covered = self.offload.pool.coverage(
+                usable, start_page=matched
+            )
+            if matched + covered >= total:
+                return 0  # local tiers cover it — no fetch
+            return self.pagestore.fault_in(
+                usable, start_page=matched + covered
+            )
+        except Exception:  # noqa: BLE001 - NEVER raises into admission
+            log.exception("page fault-in probe failed; re-prefilling")
+            return 0
+
+    def replicate_chain(self, token_ids: list[int]) -> int:
+        """Non-destructive export support: copy this token chain's
+        trie-resident pages into the host pool WITHOUT evicting them
+        (spill is a pure copy; eviction is a separate step), so a peer
+        fault-in can pack the chain while local sessions keep decoding
+        on it. Contrast ``park_chain``, which frees the HBM pages.
+        Returns pages newly copied (0 = already pool-resident or not
+        trie-resident)."""
+        if self.offload is None:
+            return 0
+        from .offload.pool import chain_key_hex
+
+        with self.lock:
+            self.offload.flush()
+            pages = self.alloc.match_prefix(token_ids)
+            if not pages:
+                return 0
+            P = self.cfg.page_size
+            have = set(self.offload.pool.digests())
+            chains = [
+                (pg, token_ids[: (i + 1) * P])
+                for i, pg in enumerate(pages)
+                if chain_key_hex(token_ids[: (i + 1) * P]) not in have
+            ]
+            if not chains:
+                return 0
+            self.offload.spill(self.cache, chains, trigger="replicate")
+            return self.offload.flush()
 
     def park_chain(self, token_ids: list[int]) -> int:
         """Tool-time parking: free the HBM pages holding this token

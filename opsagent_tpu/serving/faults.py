@@ -35,6 +35,11 @@ Wired fault points:
     sched.out_of_pages        admission raises OutOfPages (page storm)
     tool.exec                 agent tool exec: subprocess failure
     tool.timeout              agent tool exec: subprocess timeout
+    pagestore.fetch_timeout   fleet page fault-in: peer fetch times out
+                              (admission degrades to local re-prefill)
+    pagestore.stale_entry     fleet page fault-in: the peer answers as
+                              if the chain were LRU-evicted (directory
+                              row evicted, admission re-prefills)
 
 Every firing records a ``fault_injected`` flight event and increments
 ``opsagent_fault_injections_total{point=...}``, so tests and the

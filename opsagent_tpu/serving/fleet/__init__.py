@@ -14,8 +14,19 @@ Modules:
   restores-instead-of-reprefills exactly like a local offload hit.
 - ``client``    — the replica-side membership client (``serve-engine
   --join-fleet``): register, heartbeat, drain state for /healthz.
+- ``pagestore`` — fleet-global KV: the heartbeat digests indexed into a
+  chain->owners directory, plus the peer-to-peer page fault-in client
+  that turns an admission miss into a wire restore instead of a
+  re-prefill (tier order: HBM trie -> host pool -> peer fetch ->
+  re-prefill).
 """
 
+from .pagestore import (  # noqa: F401
+    PageDirectory,
+    PageStoreClient,
+    http_client,
+    local_client,
+)
 from .registry import ReplicaInfo, ReplicaRegistry  # noqa: F401
 from .router import (  # noqa: F401
     FleetRouter,

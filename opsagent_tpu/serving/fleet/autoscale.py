@@ -174,7 +174,10 @@ class Autoscaler:
         self._lock = threading.Lock()
         self._shed = 0
         self._seq = 0
-        self._last_launch = 0.0
+        # -inf, not 0.0: monotonic() counts from boot, so on a freshly
+        # booted host 0.0 would put the FIRST launch inside the cooldown
+        # window and silently block scale-up for cooldown_s seconds.
+        self._last_launch = float("-inf")
         self._pending: set[str] = set()    # launched, not yet promoted
         self._active: set[str] = set()     # promoted, scaler-owned
         self._idle_ticks: dict[str, int] = {}

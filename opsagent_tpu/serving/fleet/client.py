@@ -77,15 +77,21 @@ class FleetMembership:
     def _payload(self, full: bool) -> dict[str, Any]:
         eng = self.stack.engine
         sched = self.stack.scheduler
+        load: dict[str, Any] = {
+            "running": len(sched._running),
+            "queued": len(sched._waiting) + sched._queue.qsize(),
+            "prefilling": len(sched._prefilling),
+            "free_pages": eng.alloc.free_pages,
+        }
+        if getattr(eng, "offload", None) is not None:
+            load["host_pool_pages"] = eng.offload.pool.num_pages
         body: dict[str, Any] = {
             "replica_id": self.replica_id,
-            "load": {
-                "running": len(sched._running),
-                "queued": len(sched._waiting) + sched._queue.qsize(),
-                "prefilling": len(sched._prefilling),
-                "free_pages": eng.alloc.free_pages,
-            },
+            "load": load,
             "digests": eng.prefix_digests(),
+            "digest_truncated": bool(
+                getattr(eng, "digests_truncated", lambda: False)()
+            ),
         }
         if full:
             body.update({

@@ -28,6 +28,7 @@ from typing import Any
 from ... import obs
 from ...utils.logger import get_logger
 from ..offload.pool import chain_key_hex
+from .pagestore import PageDirectory
 
 log = get_logger("fleet.registry")
 
@@ -120,6 +121,7 @@ class ReplicaInfo:
     page_size: int = 64
     mesh: dict[str, int] = field(default_factory=dict)   # tp/sp/ep shape
     digests: set[str] = field(default_factory=set)
+    digest_truncated: bool = False   # advertisement hit the digest cap
     load: dict[str, Any] = field(default_factory=dict)
     draining: bool = False
     local: bool = False            # polled live; heartbeat TTL waived
@@ -171,6 +173,7 @@ class ReplicaInfo:
             "state": "draining" if self.draining else "active",
             "local": self.local,
             "digest_count": len(self.digests),
+            "digest_truncated": self.digest_truncated,
             "load": dict(self.load),
             "heartbeat_age_s": round(
                 time.monotonic() - self.last_heartbeat, 3
@@ -190,6 +193,9 @@ class ReplicaRegistry:
         self._replicas: dict[str, ReplicaInfo] = {}
         self._health: dict[str, ReplicaHealth] = {}
         self.reaped = 0
+        # Fleet-global KV directory: chain_key_hex -> owning replicas,
+        # kept in lockstep with the digest advertisements above.
+        self.directory = PageDirectory()
 
     # -- membership --------------------------------------------------------
     def register(self, info: ReplicaInfo) -> None:
@@ -199,6 +205,7 @@ class ReplicaRegistry:
             # A (re-)registration is a fresh process (or an operator's
             # explicit rejoin): start from a clean health slate.
             self._health[info.replica_id] = ReplicaHealth()
+        self.directory.update(info.replica_id, info.digests)
         log.info(
             "replica %s registered (role=%s model=%s url=%s capacity=%d "
             "digests=%d)", info.replica_id, info.role, info.model,
@@ -211,6 +218,7 @@ class ReplicaRegistry:
         replica_id: str,
         load: dict[str, Any] | None = None,
         digests: list[str] | None = None,
+        digest_truncated: bool | None = None,
     ) -> bool:
         """Refresh liveness (+ optionally load/digests). Returns False
         for unknown ids — the replica should re-register (it was reaped
@@ -224,12 +232,22 @@ class ReplicaRegistry:
                 info.load = dict(load)
             if digests is not None:
                 info.digests = set(digests)
+            if digest_truncated is not None:
+                info.digest_truncated = bool(digest_truncated)
+            draining = info.draining
+        if digests is not None:
+            # Draining replicas are already directory-invisible; keep
+            # them out even if late heartbeats still advertise chains.
+            self.directory.update(
+                replica_id, () if draining else digests
+            )
         return True
 
     def deregister(self, replica_id: str) -> bool:
         with self._lock:
             gone = self._replicas.pop(replica_id, None)
             self._health.pop(replica_id, None)
+        self.directory.remove_replica(replica_id)
         if gone is not None:
             log.info("replica %s deregistered", replica_id)
             self._observe()
@@ -241,6 +259,13 @@ class ReplicaRegistry:
             if info is None:
                 return False
             info.draining = draining
+            digests = set(info.digests)
+        if draining:
+            # A draining replica is about to migrate its chains away
+            # and exit — stop advertising it as a fault-in source.
+            self.directory.remove_replica(replica_id)
+        else:
+            self.directory.update(replica_id, digests)
         self._observe()
         return True
 
@@ -281,6 +306,7 @@ class ReplicaRegistry:
                     self._health.pop(rid, None)
         for rid in dead:
             self.reaped += 1
+            self.directory.remove_replica(rid)
             log.warning(
                 "replica %s reaped (no heartbeat for > %.1fs)",
                 rid, self.ttl_s,
@@ -301,7 +327,14 @@ class ReplicaRegistry:
             try:
                 info.load = info.handle.load_snapshot()
                 info.digests = set(info.handle.prefix_digests())
+                info.digest_truncated = bool(
+                    getattr(info.handle, "digests_truncated", lambda: False)()
+                )
                 info.last_heartbeat = time.monotonic()
+                self.directory.update(
+                    info.replica_id,
+                    () if info.draining else info.digests,
+                )
             except Exception:  # noqa: BLE001 - a dying local replica
                 log.exception(
                     "local replica %s poll failed", info.replica_id
@@ -418,6 +451,7 @@ class ReplicaRegistry:
             "replicas": rows,
             "heartbeat_ttl_s": self.ttl_s,
             "reaped_total": self.reaped,
+            "directory": self.directory.stats(),
         }
 
     def _observe(self) -> None:

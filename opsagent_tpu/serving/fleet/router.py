@@ -176,16 +176,22 @@ class LocalReplica:
     def load_snapshot(self) -> dict[str, Any]:
         eng = self.stack.engine
         sched = self.stack.scheduler
-        return {
+        out = {
             "running": len(sched._running),
             "queued": len(sched._waiting) + sched._queue.qsize(),
             "prefilling": len(sched._prefilling),
             "free_pages": eng.alloc.free_pages,
             "goodput": {},
         }
+        if eng.offload is not None:
+            out["host_pool_pages"] = eng.offload.pool.num_pages
+        return out
 
     def prefix_digests(self) -> list[str]:
         return self.stack.engine.prefix_digests()
+
+    def digests_truncated(self) -> bool:
+        return self.stack.engine.digests_truncated()
 
     # KV transfer plane
     def park_tokens(self, token_ids: list[int]) -> int:
@@ -195,7 +201,8 @@ class LocalReplica:
         return parked
 
     def export_pages(
-        self, token_ids: list[int], park: bool = True
+        self, token_ids: list[int], park: bool = True,
+        start_page: int = 0,
     ) -> list[dict[str, Any]]:
         eng = self.stack.engine
         if eng.offload is None:
@@ -203,8 +210,13 @@ class LocalReplica:
         if park:
             self.park_tokens(token_ids)
         else:
-            eng.offload_flush()
-        return pack_entries(eng.offload.pool.entries_for(token_ids))
+            # Peer fault-in must not cost this replica its own cache:
+            # replicate trie-resident pages into the pool (copy, not
+            # evict) so the pack below can serve them.
+            eng.replicate_chain(token_ids)
+        return pack_entries(
+            eng.offload.pool.match(token_ids, start_page=start_page)
+        )
 
     def import_pages(self, records: list[dict[str, Any]]) -> int:
         eng = self.stack.engine
@@ -326,12 +338,23 @@ class HttpReplica:
         ).get("parked_tokens", 0))
 
     def export_pages(
-        self, token_ids: list[int], park: bool = True
+        self, token_ids: list[int], park: bool = True,
+        start_page: int = 0,
     ) -> list[dict[str, Any]]:
-        return self._call(
-            "/fleet/kv/export", {"tokens": token_ids, "park": park},
+        out = self._call(
+            "/fleet/kv/export",
+            {
+                "chains": [
+                    {"tokens": token_ids, "start_page": start_page}
+                ],
+                "park": park,
+            },
             timeout_s=60.0,
-        ).get("pages", [])
+        )
+        results = out.get("results")
+        if results:
+            return results[0].get("pages", [])
+        return out.get("pages", [])
 
     def import_pages(self, records: list[dict[str, Any]]) -> int:
         return int(self._call(
@@ -392,6 +415,7 @@ class FleetRouter:
         max_failovers: int = DEFAULT_MAX_FAILOVERS,
         hedge_queue_depth: int | None = None,
         shed_queue_depth: int | None = None,
+        pagestore: bool = True,
     ):
         """``sticky=False`` disables session->replica pinning (every turn
         re-places from scratch). ``placement="round_robin"`` replaces the
@@ -408,7 +432,16 @@ class FleetRouter:
         duplicate of a queued cold non-streaming admission on a second
         replica, ``shed_queue_depth`` (None = off) sheds new admissions
         with 429 + Retry-After once EVERY live decode replica's queue
-        is at or past the watermark."""
+        is at or past the watermark.
+
+        ``pagestore`` wires every ``add_local`` replica's engine with a
+        fleet-global KV fault-in client against this router's directory
+        (fleet/pagestore.py): an admission that misses its local trie +
+        host pool fetches the chain peer-to-peer instead of
+        re-prefilling. With the directory on, affinity placement is a
+        locality optimization, not a correctness crutch — any live
+        replica can serve a known session. ``pagestore=False`` is the
+        A/B OFF phase (pre-directory behavior)."""
         self.registry = registry or ReplicaRegistry()
         self.affinity = affinity
         self.sticky = sticky
@@ -422,6 +455,7 @@ class FleetRouter:
         self.shed_queue_depth = shed_queue_depth
         self._tokenizer = tokenizer
         self._model_family = model_family
+        self.pagestore = pagestore
         self._lock = threading.Lock()
         self._pins: OrderedDict[str, str] = OrderedDict()     # session->rid
         self._owners: OrderedDict[str, str] = OrderedDict()   # req id->rid
@@ -440,6 +474,12 @@ class FleetRouter:
         the autoscaler promotes it."""
         handle = LocalReplica(stack, replica_id, role=role)
         self.registry.register(handle.info())
+        if self.pagestore and stack.engine.offload is not None:
+            from .pagestore import local_client
+
+            stack.engine.pagestore = local_client(
+                self.registry, replica_id, stack.engine
+            )
         return handle
 
     # -- session identity ---------------------------------------------------
@@ -665,6 +705,14 @@ class FleetRouter:
         self, d: RouteDecision, token_ids: list[int] | None, reason: str
     ) -> None:
         if d.migrate_from is None or not token_ids:
+            return
+        if reason == "misroute" and self.pagestore:
+            # Fleet-global KV demotes the eager misroute push: the
+            # receiving replica faults the chain in (pull) through the
+            # page directory at admission, so a push here would only
+            # duplicate the transfer. Failover and drain pushes stay —
+            # their source is failing/leaving and may be gone from the
+            # directory by the time the receiver asks.
             return
         src = self.registry.get(d.migrate_from)
         if src is None or src.handle is None or d.replica.handle is None:
@@ -1252,6 +1300,7 @@ def build_router_app(router: FleetRouter):
             "health": router.registry.health_snapshot(),
             "queued": sum(r.queue_depth() for r in replicas),
             "shed_queue_depth": router.shed_queue_depth,
+            "directory": router.registry.directory.stats(),
         }
         if router.autoscaler is not None:
             out["autoscale"] = router.autoscaler.snapshot()
@@ -1309,6 +1358,7 @@ def build_router_app(router: FleetRouter):
             page_size=int(body.get("page_size", 64)),
             mesh=dict(body.get("mesh") or {}),
             digests=set(body.get("digests") or ()),
+            digest_truncated=bool(body.get("digest_truncated", False)),
             load=dict(body.get("load") or {}),
             handle=HttpReplica(url, rid),
         )
@@ -1329,6 +1379,7 @@ def build_router_app(router: FleetRouter):
             body.get("replica_id", ""),
             load=body.get("load"),
             digests=body.get("digests"),
+            digest_truncated=body.get("digest_truncated"),
         )
         if not ok:
             # 410: the replica was reaped (or the router restarted) — it
@@ -1338,6 +1389,73 @@ def build_router_app(router: FleetRouter):
                 status=410,
             )
         return web.json_response({"status": "ok"})
+
+    async def directory_lookup(request: web.Request) -> web.Response:
+        # Fleet-global KV: a replica that missed locally asks which
+        # peers own these chain keys. Owners come back WITH their
+        # advertised URLs so the replica fetches peer-to-peer — page
+        # payloads never transit the router.
+        try:
+            body = await request.json()
+            keys = [str(k) for k in body.get("keys") or []]
+        except (json.JSONDecodeError, TypeError):
+            return web.json_response(
+                {"error": {"message": "keys must be a string list"}},
+                status=400,
+            )
+
+        def _lookup() -> dict[str, Any]:
+            asking = request.query.get("replica") or ""
+            out: dict[str, list[dict[str, str]]] = {}
+            for key, rids in router.registry.directory.owners(
+                keys
+            ).items():
+                owners = []
+                for rid in rids:
+                    if rid == asking:
+                        continue
+                    info = router.registry.get(rid)
+                    if info is None or info.draining:
+                        continue
+                    owners.append({"id": rid, "url": info.url})
+                if owners:
+                    out[key] = owners
+            return {"owners": out}
+
+        return web.json_response(await _exec(_lookup))
+
+    async def directory_get(request: web.Request) -> web.Response:
+        # Operator view (``opsagent fleet-kv``): the directory rows plus
+        # each replica's tier footprint (trie+pool digest count, host
+        # pool size, truncation).
+        def _snap() -> dict[str, Any]:
+            router.registry.refresh_local()
+            try:
+                limit = int(request.query.get("limit", 256))
+            except ValueError:
+                limit = 256
+            snap = router.registry.directory.snapshot(limit=limit)
+            snap["replicas"] = [
+                {
+                    "id": info.replica_id,
+                    "role": info.role,
+                    "state": (
+                        "draining" if info.draining else "active"
+                    ),
+                    "digest_count": len(info.digests),
+                    "digest_truncated": info.digest_truncated,
+                    "host_pool_pages": info.load.get(
+                        "host_pool_pages", 0
+                    ),
+                    "heartbeat_age_s": round(
+                        time.monotonic() - info.last_heartbeat, 3
+                    ),
+                }
+                for info in router.registry.all()
+            ]
+            return snap
+
+        return web.json_response(await _exec(_snap))
 
     async def deregister(request: web.Request) -> web.Response:
         try:
@@ -1367,9 +1485,11 @@ def build_router_app(router: FleetRouter):
     app.router.add_get("/api/slo", slo_get)
     app.router.add_get("/api/fleet", fleet_get)
     app.router.add_get("/api/fleet/bench", fleet_bench)
+    app.router.add_get("/api/fleet/directory", directory_get)
     app.router.add_get("/api/timeline/{request_id}", timeline_get)
     app.router.add_post("/fleet/register", register)
     app.router.add_post("/fleet/heartbeat", heartbeat)
+    app.router.add_post("/fleet/directory/lookup", directory_lookup)
     app.router.add_post("/fleet/deregister", deregister)
     app.router.add_post("/fleet/drain/{replica_id}", drain)
     return app

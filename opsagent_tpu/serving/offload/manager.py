@@ -36,6 +36,17 @@ class OffloadManager:
         self.restored_tokens = 0
         self.restored_pages = 0
         self.spilled_pages = 0
+        # remote tier: restored tokens whose pages arrived by peer
+        # fault-in (fleet/pagestore.py) rather than a local spill — the
+        # tier between host-pool-hit and re-prefill
+        self.remote_hit_tokens = 0
+
+    def note_remote_hit(self, tokens: int) -> None:
+        """Credit ``tokens`` of the last restore to the peer-fetch tier
+        (the engine calls this when an admission's restore was preceded
+        by a page fault-in that landed the pages)."""
+        if tokens > 0:
+            self.remote_hit_tokens += int(tokens)
 
     # -- device -> host (spill) --------------------------------------------
     def spill(
@@ -138,5 +149,6 @@ class OffloadManager:
             "restored_pages": self.restored_pages,
             "restored_tokens": self.restored_tokens,
             "spilled_pages": self.spilled_pages,
+            "remote_hit_tokens": self.remote_hit_tokens,
             "pending_pages": self.copier.pending_pages,
         }

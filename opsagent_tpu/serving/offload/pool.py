@@ -177,6 +177,27 @@ class HostPagePool:
             self.hits += len(out)
         return out
 
+    def coverage(
+        self, tokens: list[int] | np.ndarray, start_page: int = 0
+    ) -> int:
+        """Non-mutating probe: how many consecutive pages of ``tokens``
+        from ``start_page`` are resident. Unlike :meth:`match` this
+        neither bumps recency nor counts hit/miss stats — it answers
+        "would a restore cover this?" for the fault-in tier decision
+        without perturbing the LRU."""
+        toks = np.ascontiguousarray(tokens, dtype=np.int32)
+        P = self.page_size
+        n = 0
+        with self._lock:
+            for i in range(start_page, toks.size // P):
+                ent = self._entries.get(_chain_key(toks[: (i + 1) * P]))
+                if ent is None or not np.array_equal(
+                    ent.tokens, toks[: (i + 1) * P]
+                ):
+                    break
+                n += 1
+        return n
+
     def drop_chain(self, tokens: list[int] | np.ndarray) -> int:
         """Drop every resident page of this token chain (tests / explicit
         invalidation). Returns the number of pages dropped."""

@@ -201,6 +201,21 @@ def terminal_summary(paths: list[str]) -> int:
             f"re-prefill avoided {e.get('reprefill_avoided_tokens', 0)} "
             f"vs {e.get('off_reprefill_avoided_tokens', 0)} tok"
         )
+    fgkv = [d for d in tpu if d["metric"].startswith("fleet_global_kv")]
+    if fgkv:
+        e = fgkv[-1].get("extra", {})
+        print(
+            f"fleet-global-KV A/B ({e.get('replicas', '?')} replicas "
+            f"+{e.get('standby', 0)} standby): "
+            f"{e.get('remote_hit_pages', 0)} pages faulted in peer-to-peer "
+            f"(vs {e.get('off_remote_hit_pages', 0)} off); re-prefill "
+            f"avoided {e.get('reprefill_avoided_tokens', 0)} vs "
+            f"{e.get('off_reprefill_avoided_tokens', 0)} tok; moved-turn "
+            f"p50 {e.get('p50_moved_ms', 0)} ms (on) vs "
+            f"{e.get('off_p50_moved_ms', 0)} ms (off); outputs identical: "
+            f"{e.get('outputs_identical')}, standby: "
+            f"{e.get('standby_identical')}"
+        )
     chaos = [d for d in tpu if d["metric"].startswith("fleet_chaos")]
     if chaos:
         e = chaos[-1].get("extra", {})

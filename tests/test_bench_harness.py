@@ -293,6 +293,41 @@ def test_fleet_affinity_mode_reports_ab_numbers():
     assert any("round_robin" in k for k in e["route_decisions"])
 
 
+def test_fleet_global_kv_mode_reports_ab_numbers():
+    """OPSAGENT_BENCH_MODE=fleet-global-kv (the tier-1-safe fast-lane
+    form of the fleet-global KV A/B stage: CPU, tiny model, 2 replicas
+    + 1 standby behind the FleetRouter). The ON phase forces second
+    turns onto a NON-owning replica and third turns onto a freshly
+    promoted standby: both must restore over the wire (remote_hit_pages
+    > 0) with greedy output byte-identical to the never-moved replay.
+    The OFF phase (directory disabled) proves the delta: zero remote
+    hits, strictly less re-prefill avoided."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "fleet-global-kv",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("fleet_global_kv[")
+    assert parsed["unit"] == "tok/s/chip"
+    e = parsed["extra"]
+    assert e["errors"] == 0
+    assert e["replicas"] == 2 and e["standby"] == 1
+    # The ON phase faulted pages in peer-to-peer; OFF could not.
+    assert e["remote_hit_pages"] > 0
+    assert e["off_remote_hit_pages"] == 0
+    assert e["fetch_bytes"] > 0
+    # Byte-identical on the non-owner AND on the promoted standby.
+    assert e["outputs_identical"] is True
+    assert e["standby_identical"] is True
+    # The directory did the resolving.
+    assert e["directory"]["hits"] > 0
+
+
 def test_fleet_chaos_mode_zero_failed_requests_under_faults():
     """OPSAGENT_BENCH_MODE=fleet-chaos (the tier-1-safe fast-lane form of
     the chaos A/B stage: CPU, tiny model, 2 in-process replicas, seeded

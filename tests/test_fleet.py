@@ -188,6 +188,58 @@ def test_unpack_rejects_tampered_payload_by_digest():
     assert rejects and rejects[-1]["cause"] == "digest_mismatch"
 
 
+def test_export_racing_lru_eviction_yields_clean_miss_never_torn():
+    """Fleet-global KV race: a chain can be LRU-evicted between the
+    directory lookup and the /fleet/kv/export pack. The export side
+    (pool.match under the pool lock -> pack_entries over immutable
+    entries) must yield either a complete digest-valid chain prefix or
+    a clean miss — never an exception or a torn/gappy record set."""
+    tree_bytes = 2 * 2 * 4 * 1 * 8 * 4  # k+v, float32
+    # Capacity for exactly one 3-page chain: inserting the other chain
+    # evicts the first page-by-page, so the reader constantly races.
+    pool = HostPagePool(page_size=4, capacity_bytes=3 * tree_bytes)
+    toks_a = list(range(100, 112))
+    toks_b = list(range(200, 212))
+    rng = np.random.default_rng(0)
+
+    def _tree():
+        return {
+            "k": rng.standard_normal((2, 4, 1, 8)).astype(np.float32),
+            "v": rng.standard_normal((2, 4, 1, 8)).astype(np.float32),
+        }
+
+    trees_a = [_tree() for _ in range(3)]
+    trees_b = [_tree() for _ in range(3)]
+    stop = threading.Event()
+    writer_errors = []
+
+    def writer():
+        try:
+            while not stop.is_set():
+                for toks, trees in ((toks_a, trees_a), (toks_b, trees_b)):
+                    for p in range(3):
+                        pool.put(toks[: (p + 1) * 4], trees[p])
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            writer_errors.append(e)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    template = {"k": np.zeros((1,)), "v": np.zeros((1,))}
+    try:
+        for _ in range(300):
+            records = pack_entries(pool.match(toks_a))
+            out = unpack_entries(records, template)
+            # Every packed record digest-verifies (no torn payloads)...
+            assert len(out) == len(records)
+            # ...and the set is a contiguous chain prefix from page 0.
+            for j, (chain, _) in enumerate(out):
+                assert list(chain) == toks_a[: (j + 1) * 4]
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not writer_errors
+
+
 def test_unpack_accepts_legacy_records_without_digest():
     """Records from a pre-digest sender (rolling fleet upgrade) still
     import; digest checking is enforced only when the field is present."""
@@ -278,7 +330,15 @@ def test_forced_misroute_transfers_pages_and_matches_single_replica():
     finally:
         ref_stack.close()
 
-    router, stacks = _fleet(2)
+    # pagestore=False pins the LEGACY eager-push path: with the fleet
+    # page directory on, a misroute pulls via peer fault-in instead
+    # (tests/test_pagestore.py covers that side).
+    router = FleetRouter(pagestore=False)
+    stacks = []
+    for i in range(2):
+        stack = ServingStack(Engine(EngineConfig(**BASE)))
+        stacks.append(stack)
+        router.add_local(stack, f"r{i}")
     try:
         resp = router.complete(
             {"messages": messages, "max_tokens": 8, "temperature": 0}

@@ -1,0 +1,718 @@
+"""Fleet-global KV page store (serving/fleet/pagestore): the directory
+over heartbeat digests, the peer-to-peer fault-in client, and the tier
+order HBM trie -> host pool -> peer fetch -> re-prefill.
+
+The acceptance gates (ISSUE 12): (a) a session started on replica A
+whose next turn is forced onto replica B — with zero affinity help —
+faults the chain in through the directory and produces greedy output
+byte-identical to the never-moved run, with
+``opsagent_pagestore_remote_hits_total`` increasing; (b) under an
+injected ``pagestore.fetch_timeout`` the same request completes via
+local re-prefill with no client-visible error; (c) stale directory rows
+(peer evicted the chain between heartbeat and fetch) are evicted, never
+retried.
+"""
+
+import asyncio
+import urllib.error
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from opsagent_tpu import obs
+from opsagent_tpu.serving import faults
+from opsagent_tpu.serving.api import ServingStack
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.fleet.pagestore import (
+    PageDirectory,
+    PageStoreClient,
+)
+from opsagent_tpu.serving.fleet.registry import (
+    ReplicaInfo,
+    ReplicaRegistry,
+)
+from opsagent_tpu.serving.fleet.router import (
+    FleetRouter,
+    build_router_app,
+)
+from opsagent_tpu.serving.fleet.transfer import (
+    pack_entries,
+    records_nbytes,
+)
+from opsagent_tpu.serving.offload.pool import HostPagePool, chain_key_hex
+
+BASE = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+    num_pages=256, max_pages_per_seq=64, max_batch_size=4,
+    prefill_buckets=(16, 32, 64), decode_block=4, seed=0,
+    offload=True,
+)
+
+
+def _close(stacks):
+    for s in stacks:
+        s.close()
+
+
+# -- directory ----------------------------------------------------------------
+class TestPageDirectory:
+    def test_update_owners_freshest_first(self):
+        d = PageDirectory()
+        d.update("a", ["k1", "k2"])
+        d.update("b", ["k2", "k3"])
+        out = d.owners(["k1", "k2", "k3", "k4"])
+        assert out["k1"] == ["a"]
+        # b advertised k2 after a: freshest advertisement ranks first.
+        assert out["k2"] == ["b", "a"]
+        assert out["k3"] == ["b"]
+        assert "k4" not in out
+        st = d.stats()
+        assert st["chains"] == 3 and st["replicas"] == 2
+        assert st["hits"] == 3 and st["misses"] == 1
+
+    def test_update_is_wholesale_replacement(self):
+        d = PageDirectory()
+        d.update("a", ["k1", "k2"])
+        d.update("a", ["k2", "k3"])  # heartbeat: k1 aged out of the pool
+        out = d.owners(["k1", "k2", "k3"])
+        assert "k1" not in out and out["k2"] == ["a"]
+        d.update("a", [])            # drained replica advertises nothing
+        assert d.owners(["k2", "k3"]) == {}
+        assert d.stats()["chains"] == 0
+
+    def test_remove_replica_keeps_other_owners(self):
+        d = PageDirectory()
+        d.update("a", ["k1", "k2"])
+        d.update("b", ["k2"])
+        assert d.remove_replica("a") == 2
+        out = d.owners(["k1", "k2"])
+        assert "k1" not in out and out["k2"] == ["b"]
+        assert d.remove_replica("ghost") == 0
+
+    def test_invalidate_evicts_single_row(self):
+        d = PageDirectory()
+        d.update("a", ["k1", "k2"])
+        assert d.invalidate("k1", "a")
+        assert not d.invalidate("k1", "a")  # already gone
+        out = d.owners(["k1", "k2"])
+        # Only the stale row died; the replica's other rows stay valid.
+        assert "k1" not in out and out["k2"] == ["a"]
+        assert d.stats()["stale_evictions"] == 1
+
+    def test_snapshot_rows_and_truncation(self):
+        d = PageDirectory()
+        d.update("a", [f"k{i}" for i in range(5)])
+        snap = d.snapshot(limit=3)
+        assert len(snap["rows"]) == 3 and snap["truncated"]
+        row = snap["rows"][0]
+        assert row["owners"][0]["id"] == "a"
+        assert row["owners"][0]["age_s"] >= 0
+
+
+# -- registry feeds the directory ---------------------------------------------
+class TestRegistryDirectory:
+    def test_register_heartbeat_and_deregister_update_directory(self):
+        reg = ReplicaRegistry()
+        reg.register(
+            ReplicaInfo(replica_id="a", url="http://x", digests={"k1"})
+        )
+        assert reg.directory.owners(["k1"])["k1"] == ["a"]
+        reg.heartbeat("a", digests=["k2"])
+        out = reg.directory.owners(["k1", "k2"])
+        assert "k1" not in out and out["k2"] == ["a"]
+        reg.deregister("a")
+        assert reg.directory.owners(["k2"]) == {}
+
+    def test_reap_invalidates_directory(self):
+        import time
+
+        reg = ReplicaRegistry(ttl_s=0.2)
+        reg.register(
+            ReplicaInfo(replica_id="a", url="http://x", digests={"k1"})
+        )
+        time.sleep(0.3)
+        reg.alive()  # reap pass
+        assert reg.get("a") is None
+        assert reg.directory.owners(["k1"]) == {}
+
+    def test_drain_removes_and_undrain_restores(self):
+        reg = ReplicaRegistry()
+        reg.register(
+            ReplicaInfo(replica_id="a", url="http://x", digests={"k1"})
+        )
+        reg.set_draining("a")
+        assert reg.directory.owners(["k1"]) == {}
+        reg.set_draining("a", False)
+        assert reg.directory.owners(["k1"])["k1"] == ["a"]
+
+
+# -- router HTTP surface: directory routes ------------------------------------
+def test_directory_http_endpoints_round_trip():
+    """POST /fleet/directory/lookup (the fault-in client's resolver:
+    owners WITH urls, asker excluded, draining skipped) and GET
+    /api/fleet/directory (the ``opsagent fleet-kv`` operator view)."""
+    router = FleetRouter()
+    app = build_router_app(router)
+
+    async def scenario():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/fleet/register", json={
+                "replica_id": "remote-1", "url": "http://127.0.0.1:1",
+                "model": "tiny-test", "capacity": 2, "page_size": 4,
+                "digests": ["aa", "bb"],
+            })
+            assert r.status == 200
+            r = await client.post("/fleet/register", json={
+                "replica_id": "remote-2", "url": "http://127.0.0.1:2",
+                "model": "tiny-test", "capacity": 2, "page_size": 4,
+                "digests": ["bb"],
+            })
+            assert r.status == 200
+
+            r = await client.post(
+                "/fleet/directory/lookup", json={"keys": ["aa", "zz"]}
+            )
+            assert r.status == 200
+            owners = (await r.json())["owners"]
+            assert owners["aa"] == [
+                {"id": "remote-1", "url": "http://127.0.0.1:1"}
+            ]
+            assert "zz" not in owners
+
+            # The asking replica is excluded: a replica never fetches
+            # from itself.
+            r = await client.post(
+                "/fleet/directory/lookup?replica=remote-1",
+                json={"keys": ["aa"]},
+            )
+            assert (await r.json())["owners"] == {}
+
+            # Both owners of a shared chain, then drain one: it stops
+            # being advertised as a fault-in source.
+            r = await client.post(
+                "/fleet/directory/lookup", json={"keys": ["bb"]}
+            )
+            ids = {o["id"] for o in (await r.json())["owners"]["bb"]}
+            assert ids == {"remote-1", "remote-2"}
+            router.registry.set_draining("remote-2")
+            r = await client.post(
+                "/fleet/directory/lookup", json={"keys": ["bb"]}
+            )
+            ids = {o["id"] for o in (await r.json())["owners"]["bb"]}
+            assert ids == {"remote-1"}
+
+            r = await client.post(
+                "/fleet/directory/lookup", data=b"not json"
+            )
+            assert r.status == 400
+
+            r = await client.get("/api/fleet/directory?limit=1")
+            assert r.status == 200
+            snap = await r.json()
+            assert snap["stats"]["chains"] >= 1
+            assert len(snap["rows"]) == 1 and snap["truncated"]
+            rep = {row["id"]: row for row in snap["replicas"]}
+            assert rep["remote-1"]["digest_count"] == 2
+            assert rep["remote-2"]["state"] == "draining"
+
+            # The router /healthz carries the directory stats block.
+            r = await client.get("/healthz")
+            assert "directory" in (await r.json())
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_fleet_kv_cli_renders_directory(capsys, monkeypatch):
+    """``opsagent fleet-kv --url <router>``: the operator's view of the
+    fleet page directory, fetched over urllib from a real port."""
+    import sys as _sys
+    import threading
+
+    from opsagent_tpu.cli.main import main as cli_main
+
+    router = FleetRouter()
+    router.registry.register(ReplicaInfo(
+        replica_id="remote-1", url="http://127.0.0.1:1",
+        digests={"aa" * 16, "bb" * 16},
+    ))
+    app = build_router_app(router)
+    loop = asyncio.new_event_loop()
+    box = {}
+
+    async def _start():
+        from aiohttp import web
+
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        box["runner"] = runner
+        box["port"] = runner.addresses[0][1]
+
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    asyncio.run_coroutine_threadsafe(_start(), loop).result(timeout=30)
+    try:
+        url = f"http://127.0.0.1:{box['port']}"
+        monkeypatch.setattr(
+            _sys, "argv", ["opsagent", "fleet-kv", "--url", url]
+        )
+        assert cli_main() == 0
+        out = capsys.readouterr().out
+        assert "directory: 2 chains" in out
+        assert "remote-1" in out
+        # --json prints the raw snapshot.
+        monkeypatch.setattr(
+            _sys, "argv",
+            ["opsagent", "fleet-kv", "--url", url, "--json"],
+        )
+        assert cli_main() == 0
+        import json as _json
+
+        snap = _json.loads(capsys.readouterr().out)
+        assert snap["stats"]["chains"] == 2
+        # Unreachable router: clean error on stderr, exit 1.
+        monkeypatch.setattr(
+            _sys, "argv",
+            ["opsagent", "fleet-kv", "--url", "http://127.0.0.1:9"],
+        )
+        assert cli_main() == 1
+        assert "directory fetch failed" in capsys.readouterr().err
+    finally:
+        async def _stop():
+            await box["runner"].cleanup()
+
+        asyncio.run_coroutine_threadsafe(_stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)
+
+
+# -- fault-in client (stubbed peers) ------------------------------------------
+def _source_pool():
+    """A peer's host pool holding a 3-page chain, plus the matching
+    records template."""
+    pool = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+    toks = list(range(500, 512))
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        tree = {
+            "k": rng.standard_normal((2, 4, 1, 8)).astype(np.float32),
+            "v": rng.standard_normal((2, 4, 1, 8)).astype(np.float32),
+        }
+        assert pool.put(toks[: (i + 1) * 4], tree)
+    return pool, toks
+
+
+def _template():
+    return {"k": np.zeros((1,)), "v": np.zeros((1,))}
+
+
+def _client(dst, lookup, fetch, **kw):
+    return PageStoreClient(
+        self_id="me", page_size=4, pool=dst, template=_template,
+        lookup=lookup, fetch=fetch, **kw,
+    )
+
+
+class TestPageStoreClient:
+    def test_fault_in_lands_chain_in_local_pool(self):
+        src, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=lambda o, t, sp, ts: pack_entries(
+                src.match(t, start_page=sp)
+            ),
+        )
+        assert c.fault_in(toks, start_page=0) == 3
+        assert len(dst.match(toks)) == 3
+        assert set(dst.digests()) == set(src.digests())
+        assert c.stats()["remote_hit_pages"] == 3
+        assert c.stats()["fallbacks"] == 0
+
+    def test_partial_chain_fetch_starts_past_local_pages(self):
+        src, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=lambda o, t, sp, ts: pack_entries(
+                src.match(t, start_page=sp)
+            ),
+        )
+        # Pages 0..1 already local (trie/pool tier): only page 2 fetches.
+        assert c.fault_in(toks, start_page=2) == 1
+        assert dst.num_pages == 1
+
+    def test_self_is_never_a_peer(self):
+        _, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "me"}] for k in keys},
+            fetch=lambda o, t, sp, ts: pytest.fail("fetched from self"),
+        )
+        assert c.fault_in(toks, start_page=0) == 0
+        assert c.stats()["fallbacks"] == 1  # reason=no_owner
+
+    def test_timeout_degrades_to_reprefill_not_raise(self):
+        _, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+
+        def fetch(o, t, sp, ts):
+            raise TimeoutError("peer wedged")
+
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=fetch,
+        )
+        before = obs.metrics_snapshot().get(
+            'opsagent_pagestore_fallbacks_total{reason="timeout"}', 0.0
+        )
+        assert c.fault_in(toks, start_page=0) == 0
+        assert dst.num_pages == 0
+        assert obs.metrics_snapshot().get(
+            'opsagent_pagestore_fallbacks_total{reason="timeout"}', 0.0
+        ) > before
+
+    def test_second_peer_tried_after_first_fails(self):
+        src, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+
+        def fetch(o, t, sp, ts):
+            if o["id"] == "p1":
+                raise TimeoutError("p1 wedged")
+            return pack_entries(src.match(t, start_page=sp))
+
+        c = _client(
+            dst,
+            lookup=lambda keys: {
+                k: [{"id": "p1"}, {"id": "p2"}] for k in keys
+            },
+            fetch=fetch,
+        )
+        assert c.fault_in(toks, start_page=0) == 3
+
+    def test_empty_result_is_stale_signal_and_evicts_rows(self):
+        """The directory said the peer owns the chain; the peer says it
+        does not (LRU eviction between heartbeat and fetch). Clean miss:
+        rows evicted, no retry against the same peer."""
+        _, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        evicted = []
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=lambda o, t, sp, ts: [],
+            on_stale=lambda k, rid: evicted.append((k, rid)),
+        )
+        assert c.fault_in(toks, start_page=0) == 0
+        assert c.stats()["stale_entries"] == 3  # one per claimed chain
+        assert {rid for _, rid in evicted} == {"peer"}
+        assert {k for k, _ in evicted} == {
+            chain_key_hex(toks[: (i + 1) * 4]) for i in range(3)
+        }
+
+    def test_http_404_is_stale_signal(self):
+        _, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        evicted = []
+
+        def fetch(o, t, sp, ts):
+            raise urllib.error.HTTPError(
+                "http://peer", 404, "gone", None, None
+            )
+
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=fetch,
+            on_stale=lambda k, rid: evicted.append(k),
+        )
+        assert c.fault_in(toks, start_page=0) == 0
+        assert len(evicted) == 3
+
+    def test_digest_rejected_records_are_stale_not_imported(self):
+        src, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+
+        def fetch(o, t, sp, ts):
+            records = pack_entries(src.match(t, start_page=sp))
+            for r in records:
+                r["digest"] = "00" * 16  # corrupt peer
+            return records
+
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=fetch,
+        )
+        assert c.fault_in(toks, start_page=0) == 0
+        assert dst.num_pages == 0
+        assert c.stats()["stale_entries"] == 3
+
+    def test_size_bound_drops_tail_pages_keeps_leading(self):
+        src, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        full = pack_entries(src.match(toks))
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=lambda o, t, sp, ts: pack_entries(
+                src.match(t, start_page=sp)
+            ),
+            max_bytes=records_nbytes(full[:1]),
+        )
+        # Only the leading page fits the budget; it still lands (a
+        # partial chain restores its leading pages, the rest re-prefills).
+        assert c.fault_in(toks, start_page=0) >= 1
+        assert len(dst.match(toks)) >= 1
+
+    def test_injected_fetch_timeout_fault_point(self):
+        src, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=lambda o, t, sp, ts: pack_entries(
+                src.match(t, start_page=sp)
+            ),
+        )
+        faults.configure("pagestore.fetch_timeout@1+")
+        try:
+            assert c.fault_in(toks, start_page=0) == 0
+            assert dst.num_pages == 0
+        finally:
+            faults.reset()
+        # Injector off again: the same fetch now lands.
+        assert c.fault_in(toks, start_page=0) == 3
+
+    def test_injected_stale_entry_fault_point(self):
+        src, toks = _source_pool()
+        dst = HostPagePool(page_size=4, capacity_bytes=1 << 20)
+        evicted = []
+        c = _client(
+            dst,
+            lookup=lambda keys: {k: [{"id": "peer"}] for k in keys},
+            fetch=lambda o, t, sp, ts: pack_entries(
+                src.match(t, start_page=sp)
+            ),
+            on_stale=lambda k, rid: evicted.append(k),
+        )
+        faults.configure("pagestore.stale_entry@1")
+        try:
+            assert c.fault_in(toks, start_page=0) == 0
+            assert len(evicted) == 3
+        finally:
+            faults.reset()
+
+
+# -- digest cap (satellite 1) -------------------------------------------------
+def test_prefix_digest_cap_env_truncates_newest_win(monkeypatch):
+    stack = ServingStack(Engine(EngineConfig(**BASE)))
+    try:
+        eng = stack.engine
+        stack.chat_completion({
+            "messages": [
+                {"role": "system", "content": "digest cap test " * 4},
+                {"role": "user", "content": "a prompt long enough to "
+                                            "span several KV pages"},
+            ],
+            "max_tokens": 4, "temperature": 0,
+        })
+        uncapped = eng.prefix_digests()
+        assert len(uncapped) > 2
+        assert not eng.digests_truncated()
+        monkeypatch.setenv("OPSAGENT_FLEET_DIGEST_CAP", "2")
+        capped = eng.prefix_digests()
+        assert len(capped) == 2
+        assert eng.digests_truncated()
+        # Newest content wins: the cap keeps the advertisement's tail.
+        assert capped == uncapped[-2:]
+        # Explicit arg overrides the env.
+        assert len(eng.prefix_digests(cap=1)) == 1
+        # The registry snapshot surfaces the clipped advertisement.
+        router = FleetRouter()
+        router.add_local(stack, "r0")
+        router.registry.refresh_local()
+        row = router.registry.snapshot()["replicas"][0]
+        assert row["digest_truncated"] is True
+        assert row["digest_count"] == 2
+    finally:
+        _close([stack])
+
+
+# -- acceptance: forced non-owner fault-in, byte-identical ---------------------
+def test_forced_nonowner_faults_in_and_matches_never_moved_run():
+    """Session on replica A; next turns forced onto replica B and onto a
+    freshly promoted standby (zero affinity): both fault the chain in
+    through the directory and produce output byte-identical to the
+    single-replica run — and the old misroute push-migration stays cold
+    (affinity is a locality optimization now, not a correctness crutch)."""
+    ref_stack = ServingStack(Engine(EngineConfig(**BASE)))
+    try:
+        messages = [
+            {"role": "system", "content": "pagestore acceptance"},
+            {"role": "user", "content": "first turn here"},
+        ]
+        r1 = ref_stack.chat_completion(
+            {"messages": messages, "max_tokens": 8, "temperature": 0}
+        )
+        turn1_text = r1["choices"][0]["message"]["content"] or ""
+        turn2_msgs = list(messages) + [
+            {"role": "assistant", "content": turn1_text},
+            {"role": "user", "content": "second turn now"},
+        ]
+        r2 = ref_stack.chat_completion(
+            {"messages": turn2_msgs, "max_tokens": 8, "temperature": 0}
+        )
+        want_turn2 = r2["choices"][0]["message"]["content"] or ""
+        turn3_msgs = list(turn2_msgs) + [
+            {"role": "assistant",
+             "content": r2["choices"][0]["message"]["content"] or ""},
+            {"role": "user", "content": "third turn please"},
+        ]
+        r3 = ref_stack.chat_completion(
+            {"messages": turn3_msgs, "max_tokens": 8, "temperature": 0}
+        )
+        want_turn3 = r3["choices"][0]["message"]["content"] or ""
+    finally:
+        ref_stack.close()
+
+    router = FleetRouter()  # pagestore directory ON by default
+    stacks = []
+    for i in range(2):
+        stack = ServingStack(Engine(EngineConfig(**BASE)))
+        stacks.append(stack)
+        router.add_local(stack, f"r{i}")
+    standby = ServingStack(Engine(EngineConfig(**BASE)))
+    stacks.append(standby)
+    router.add_local(standby, "standby", role="standby")
+    try:
+        snap0 = obs.metrics_snapshot()
+        hits0 = snap0.get("opsagent_pagestore_remote_hits_total", 0.0)
+        mig0 = snap0.get(
+            'opsagent_fleet_session_migrations_total{reason="misroute"}',
+            0.0,
+        )
+        resp = router.complete(
+            {"messages": messages, "max_tokens": 8, "temperature": 0},
+            force_replica="r0",
+        )
+        assert (resp["choices"][0]["message"]["content"] or "") == \
+            turn1_text
+        # Turn 2 forced onto the NON-owner: the directory (fed by r0's
+        # digests at route-time refresh) resolves the chain, r1 fetches
+        # it peer-to-peer, and the ordinary host-restore path lands it.
+        target = router.registry.get("r1").handle
+        tgt0 = target.stack.engine.offload.restored_tokens
+        resp2 = router.complete(
+            {"messages": turn2_msgs, "max_tokens": 8, "temperature": 0},
+            force_replica="r1",
+        )
+        assert resp2["fleet"]["replica"] == "r1"
+        assert (resp2["choices"][0]["message"]["content"] or "") == \
+            want_turn2
+        snap1 = obs.metrics_snapshot()
+        assert snap1.get(
+            "opsagent_pagestore_remote_hits_total", 0.0
+        ) > hits0
+        assert target.stack.engine.offload.restored_tokens > tgt0
+        assert target.stack.engine.pagestore.stats()[
+            "remote_hit_pages"
+        ] > 0
+        # The legacy eager-push migration stayed cold: the receiver
+        # PULLED via fault-in instead.
+        assert snap1.get(
+            'opsagent_fleet_session_migrations_total{reason="misroute"}',
+            0.0,
+        ) == mig0
+        # Directory bookkeeping is visible on the router surface.
+        assert router.registry.snapshot()["directory"]["hits"] > 0
+        # Turn 3 on a replica that did not even EXIST as a decode target
+        # when the session started: promote the standby, force the turn.
+        router.registry.set_role("standby", "decode")
+        sb = router.registry.get("standby").handle
+        sb0 = sb.stack.engine.offload.restored_tokens
+        resp3 = router.complete(
+            {"messages": turn3_msgs, "max_tokens": 8, "temperature": 0},
+            force_replica="standby",
+        )
+        assert (resp3["choices"][0]["message"]["content"] or "") == \
+            want_turn3
+        assert sb.stack.engine.offload.restored_tokens > sb0
+    finally:
+        _close(stacks)
+
+
+def test_fetch_timeout_fault_degrades_to_reprefill_no_client_error():
+    """Injected pagestore.fetch_timeout on every fetch: the moved turn
+    must complete with byte-identical output via local re-prefill — the
+    peer-fetch tier is an optimization, never load-bearing."""
+    ref_stack = ServingStack(Engine(EngineConfig(**BASE)))
+    try:
+        messages = [
+            {"role": "system", "content": "pagestore timeout test"},
+            {"role": "user", "content": "first turn here"},
+        ]
+        r1 = ref_stack.chat_completion(
+            {"messages": messages, "max_tokens": 8, "temperature": 0}
+        )
+        turn2_msgs = list(messages) + [
+            {"role": "assistant",
+             "content": r1["choices"][0]["message"]["content"] or ""},
+            {"role": "user", "content": "second turn now"},
+        ]
+        r2 = ref_stack.chat_completion(
+            {"messages": turn2_msgs, "max_tokens": 8, "temperature": 0}
+        )
+        want_turn2 = r2["choices"][0]["message"]["content"] or ""
+    finally:
+        ref_stack.close()
+
+    router = FleetRouter()
+    stacks = []
+    for i in range(2):
+        stack = ServingStack(Engine(EngineConfig(**BASE)))
+        stacks.append(stack)
+        router.add_local(stack, f"r{i}")
+    try:
+        router.complete(
+            {"messages": messages, "max_tokens": 8, "temperature": 0},
+            force_replica="r0",
+        )
+        hits0 = obs.metrics_snapshot().get(
+            "opsagent_pagestore_remote_hits_total", 0.0
+        )
+        to0 = obs.metrics_snapshot().get(
+            'opsagent_pagestore_fallbacks_total{reason="timeout"}', 0.0
+        )
+        faults.configure("pagestore.fetch_timeout@1+")
+        try:
+            resp2 = router.complete(
+                {"messages": turn2_msgs, "max_tokens": 8,
+                 "temperature": 0},
+                force_replica="r1",
+            )
+        finally:
+            faults.reset()
+        # No client-visible error; output identical via re-prefill.
+        assert (resp2["choices"][0]["message"]["content"] or "") == \
+            want_turn2
+        snap = obs.metrics_snapshot()
+        assert snap.get(
+            "opsagent_pagestore_remote_hits_total", 0.0
+        ) == hits0
+        assert snap.get(
+            'opsagent_pagestore_fallbacks_total{reason="timeout"}', 0.0
+        ) > to0
+    finally:
+        _close(stacks)
