@@ -52,6 +52,11 @@ synchronous ticks (depth=1), same prompt seeds — reporting tok/s,
 host-gap p50, and overlapped-commit counts for both phases plus a
 byte-identical-output verdict; OPSAGENT_BENCH_ASYNC=<depth> pins the
 depth for any other mode.
+OPSAGENT_BENCH_MODE=sessions-ffwd runs the sessions workload with every
+completion constrained to a JSON schema, twice — grammar fast-forward
+on (forced-token runs splice into the KV without forward passes), then
+off — same prompt seeds, reporting tok/s, the forced-token fraction,
+and skipped dispatches per phase plus a byte-identical-output verdict.
 OPSAGENT_BENCH_MODE=fleet-affinity runs the sessions workload over
 OPSAGENT_BENCH_REPLICAS (default 2) in-process engine replicas behind
 the fleet router, twice — prefix-affinity + sticky placement on, then
@@ -452,6 +457,15 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         240, "sessions-async",
     ) if on_tpu else None
+    # Grammar fast-forward A/B: every completion schema-constrained,
+    # forced-token runs spliced without forward passes (on) vs paying a
+    # dispatch per token (off) — tok/s, forced-token fraction, skipped
+    # dispatches, and the byte-identical-output verdict.
+    rsessffwd = stage(
+        {"OPSAGENT_BENCH_MODE": "sessions-ffwd",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        240, "sessions-ffwd",
+    ) if on_tpu else None
     # Hierarchical-KV A/B on the same workload under page pressure:
     # offload tier off vs on (host-pool spill/park/restore) in one child.
     rsessoff = stage(
@@ -593,6 +607,17 @@ def run_orchestrated() -> None:
         extra["sessions_async_outputs_identical"] = ae.get(
             "outputs_identical"
         )
+    if rsessffwd is not None:
+        fwe = rsessffwd.get("extra", {})
+        extra["sessions_ffwd_tok_s_chip"] = rsessffwd["value"]
+        extra["sessions_ffwd_forced_fraction"] = fwe.get("forced_fraction")
+        extra["sessions_ffwd_skipped_dispatches"] = fwe.get(
+            "skipped_dispatches"
+        )
+        extra["sessions_ffwd_off_tok_s_chip"] = fwe.get("off_tok_s_chip")
+        extra["sessions_ffwd_outputs_identical"] = fwe.get(
+            "outputs_identical"
+        )
     if rsessoff is not None:
         oe = rsessoff.get("extra", {})
         extra["sessions_offload_tok_s_chip"] = rsessoff["value"]
@@ -728,8 +753,8 @@ def run_single() -> None:
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
-                "sessions-async", "fleet-affinity", "fleet-chaos",
-                "fleet-global-kv", "cold-start"):
+                "sessions-async", "sessions-ffwd", "fleet-affinity",
+                "fleet-chaos", "fleet-global-kv", "cold-start"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -843,8 +868,8 @@ def run_single() -> None:
     # -> pipelined decode), so it shares that warmup level.
     t0 = time.perf_counter()
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
-                "sessions-async", "fleet-affinity", "fleet-chaos",
-                "fleet-global-kv"):
+                "sessions-async", "sessions-ffwd", "fleet-affinity",
+                "fleet-chaos", "fleet-global-kv"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -865,6 +890,10 @@ def run_single() -> None:
     if mode == "sessions-async":
         run_sessions_async(eng, model, batch, steps, prompt_len, platform,
                            n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "sessions-ffwd":
+        run_sessions_ffwd(eng, model, batch, steps, prompt_len, platform,
+                          n_chips, quantize, init_s, warmup_s)
         return
     if mode == "sessions-offload":
         run_sessions_offload(eng, model, batch, steps, prompt_len, platform,
@@ -1166,7 +1195,8 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
 
 
 def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
-                              seed_base: int, park: bool = False) -> dict:
+                              seed_base: int, park: bool = False,
+                              extra_body: dict | None = None) -> dict:
     """Run ``batch`` concurrent multi-round chat sessions with STREAMING
     completions, measuring client-observed TTFT per round (first yielded
     chunk, error-checked). Returns {produced, wall, ttfts, errors, texts}
@@ -1204,6 +1234,7 @@ def _drive_sessions_streaming(stack, batch, rounds, gen_tokens, prompt_len,
                     "max_tokens": gen_tokens,
                     "temperature": 0.0,
                     "stream": True,
+                    **(extra_body or {}),
                 })
                 first = next(gen)
                 if "error" in first:
@@ -1398,6 +1429,102 @@ def run_sessions_async(eng, model, batch, steps, prompt_len, platform,
             ),
             "outputs_identical": identical,
             "errors": len(a["errors"]) + len(s["errors"]),
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
+            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    log_perf_table()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_sessions_ffwd(eng, model, batch, steps, prompt_len, platform,
+                      n_chips, quantize, init_s, warmup_s) -> None:
+    """The grammar fast-forward A/B stage: the concurrent-sessions
+    workload with EVERY completion constrained to the ToolPrompt JSON
+    schema (the warmup-pre-specialized one, so both phases run
+    compile-free), run TWICE against the same engine — fast-forward ON
+    (forced-token runs splice into the paged KV as multi-token appends,
+    no forward pass per forced token), then OFF (every token pays a
+    dispatch). SAME prompt seeds both phases: byte-identical output text
+    is the correctness half of the contract (a forced token is what the
+    masked sampler would have picked anyway), and the OFF phase running
+    second hands it the prefix-cache advantage — a handicap against the
+    ON phase's tok/s. Decision numbers per phase: tok/s/chip, the
+    forced-token fraction (what share of produced tokens needed no
+    forward pass), and skipped dispatch counts."""
+    from opsagent_tpu.serving.api import ServingStack
+    from opsagent_tpu.serving.constrained import TOOLPROMPT_SCHEMA
+
+    gen_tokens = max(16, steps // 8)
+    rounds = 3
+    rf = {"response_format": {"type": "json_schema", "json_schema": {
+        "name": "toolprompt", "schema": TOOLPROMPT_SCHEMA,
+    }}}
+    phases: dict[str, dict] = {}
+    for tag, on in (("on", True), ("off", False)):
+        eng.cfg.grammar_ffwd = on
+        get_perf_stats().reset()
+        snap0 = metrics_snapshot()
+        stack = ServingStack(eng)
+        try:
+            phases[tag] = _drive_sessions_streaming(
+                stack, batch, rounds, gen_tokens, prompt_len, 6000,
+                extra_body=rf,
+            )
+        finally:
+            stack.close()
+        r = phases[tag]
+        r["p50_ttft_ms"] = (
+            float(np.median(r["ttfts"]) * 1e3) if r["ttfts"] else 0.0
+        )
+        r["tok_s_chip"] = r["produced"] / max(1e-9, r["wall"]) / n_chips
+        snap1 = metrics_snapshot()
+        for short, metric in (
+            ("ffwd_tokens", "opsagent_ffwd_tokens_total"),
+            ("ffwd_runs", "opsagent_ffwd_runs_total"),
+            ("skipped_dispatches",
+             "opsagent_ffwd_skipped_dispatches_total"),
+        ):
+            r[short] = int(snap1.get(metric, 0) - snap0.get(metric, 0))
+        r["forced_fraction"] = round(
+            r["ffwd_tokens"] / max(1, r["produced"]), 3
+        )
+        log(f"bench[sessions-ffwd/{tag}]: {batch} sessions x {rounds} "
+            f"rounds, {r['produced']} tokens in {r['wall']:.2f}s -> "
+            f"{r['tok_s_chip']:.0f} tok/s/chip; forced fraction "
+            f"{r['forced_fraction']:.1%} ({r['ffwd_tokens']} tokens in "
+            f"{r['ffwd_runs']} runs, {r['skipped_dispatches']} dispatches "
+            f"skipped); errors={len(r['errors'])}")
+    a, b = phases["on"], phases["off"]
+    identical = a["texts"] == b["texts"] and not a["errors"] and not b["errors"]
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": f"sessions_ffwd[{model}{qtag},N={batch},{platform}]",
+        "value": round(a["tok_s_chip"], 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": vs_baseline(a["tok_s_chip"], model, platform),
+        "extra": {
+            "sessions": batch,
+            "rounds": rounds,
+            "p50_ttft_ms": round(a["p50_ttft_ms"], 1),
+            "forced_fraction": a["forced_fraction"],
+            "ffwd_tokens": a["ffwd_tokens"],
+            "ffwd_runs": a["ffwd_runs"],
+            "skipped_dispatches": a["skipped_dispatches"],
+            "off_tok_s_chip": round(b["tok_s_chip"], 1),
+            "off_p50_ttft_ms": round(b["p50_ttft_ms"], 1),
+            "off_skipped_dispatches": b["skipped_dispatches"],
+            "tok_s_chip_delta": round(
+                a["tok_s_chip"] - b["tok_s_chip"], 1
+            ),
+            "outputs_identical": identical,
+            "errors": len(a["errors"]) + len(b["errors"]),
             "init_s": round(init_s, 1),
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,

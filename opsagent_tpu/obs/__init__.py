@@ -150,8 +150,27 @@ ASYNC_OVERSHOOT_TOKENS = _reg.counter(
 ASYNC_FALLBACKS = _reg.counter(
     "opsagent_async_fallbacks_total",
     "Async mixed ticks that settled the pipeline and fell back to a "
-    "sync lane, by reason (hosted / fsm_mismatch / carry_break)",
+    "sync lane, by reason (hosted / fsm_mismatch / carry_break / "
+    "ffwd_ineligible = constrained row that cannot fast-forward: "
+    "hosted mask, no dense tables, or logprobs requested)",
     labelnames=("reason",),
+)
+
+FFWD_TOKENS = _reg.counter(
+    "opsagent_ffwd_tokens_total",
+    "Tokens emitted by grammar fast-forward (singleton-mask FSM states) "
+    "without a per-token forward pass",
+)
+FFWD_RUNS = _reg.counter(
+    "opsagent_ffwd_runs_total",
+    "Forced-token runs spliced as multi-token appends by the grammar "
+    "fast-forward path",
+)
+FFWD_SKIPPED_DISPATCHES = _reg.counter(
+    "opsagent_ffwd_skipped_dispatches_total",
+    "Decode dispatches the grammar fast-forward made unnecessary (one "
+    "per forced token: that token would otherwise have cost a full "
+    "forward pass)",
 )
 
 KV_PAGE_UTILIZATION = _reg.gauge(

@@ -67,6 +67,10 @@ class _Tick:
     toks_d: Any                       # device [B] sampled tokens (= carry)
     decode: list = field(default_factory=list)   # [(seq_id, lane)]
     chunks: list = field(default_factory=list)   # [(seq_id, lane, done, c)]
+    # Grammar fast-forward pre-accepts: seq_id -> forced tokens this
+    # dispatch appended BEFORE its sampled token (committed in order
+    # ahead of the pull; see _dispatch's ffwd planning).
+    ffwd: dict = field(default_factory=dict)
     t_disp: float = 0.0
     tick_id: int = 0
 
@@ -218,8 +222,78 @@ class AsyncMixedRuntime:
                     "seq %d truncated: KV page budget exhausted", s.seq_id
                 )
         decode = [s for s in grown if not s.done]
+        # Grammar fast-forward plans: a constrained decode row whose
+        # current FSM state forces a run of singleton-mask tokens appends
+        # the whole run in THIS dispatch (the q_len>1 path chunk rows
+        # already ride) and samples only the token after it — every
+        # forced token skips a forward pass. Host state must pin the
+        # row's FSM state, so rows with more than one uncommitted token
+        # wait for commits to catch up (after a fast-forward tick the
+        # row rides the normal carry for a tick, then re-engages). A
+        # CONTINUING row's one in-flight token was sampled at the forced
+        # state, so it IS run[0] by determinism: feed it from host
+        # knowledge instead of the carry and append the rest.
+        # plan: seq_id -> (anchor token, pre-accept tokens, device ov state)
+        ffwd_plan: dict[int, tuple[int, list[int], int]] = {}
+        if getattr(cfg, "grammar_ffwd", False):
+            for s in decode:
+                sid = s.seq_id
+                inflight = self._inflight_toks.get(sid, 0)
+                if inflight > 1:
+                    continue
+                fsm = device_table_fsm(s.mask_fn)
+                if fsm is None:
+                    continue
+                st0 = s.mask_fn.dfa_state(s.tokens)
+                run = fsm.forced_run(st0) if st0 >= 0 else []
+                if run and run[-1] == fsm.eos_id:
+                    # A masked sample at the eos-only state yields eos at
+                    # any temperature — the trailing eos needs no append.
+                    run = run[:-1]
+                if inflight == 1:
+                    if (
+                        sid not in self._prev_lane
+                        or sid not in self._prev_emitted
+                        or len(run) < 2
+                    ):
+                        continue
+                    anchor, pre = run[0], run[1:]
+                else:
+                    if not run:
+                        continue
+                    anchor = (
+                        s.tokens[-1] if s.tokens else eng.tokenizer.bos_id
+                    )
+                    pre = run
+                # Never overshoot max_tokens (pre + one sampled token on
+                # top of what is already in flight) or the largest bucket.
+                cap = min(
+                    s.params.max_tokens - len(s.tokens) - inflight - 1,
+                    cfg.mixed_buckets[-1] - 1,
+                )
+                pre = pre[: max(0, cap)]
+                if not pre:
+                    continue
+                # The masked sample applies the state AFTER everything
+                # this dispatch consumes beyond st0: the anchor too for
+                # a continuing row (its in-flight token was sampled AT
+                # st0), just the pre tokens for a settled one (st0
+                # already includes the anchor = last host token).
+                st = st0
+                for t in ([anchor] + pre) if inflight == 1 else pre:
+                    st = fsm.advance(st, t)
+                # Book the extra tokens; a dry pool drops the plan (the
+                # row keeps its normal one-token path).
+                try:
+                    eng.alloc.extend(sid, len(pre))
+                except OutOfPages:
+                    eng.alloc.truncate(sid, eng.alloc.length(sid))
+                    continue
+                ffwd_plan[sid] = (int(anchor), pre, st + 1)
         chunk_info: list[tuple[int, Any, int, int]] = []
         smax = 1
+        for _sid, (_a, _pre, _st) in ffwd_plan.items():
+            smax = max(smax, 1 + len(_pre))
         for sid, want in prefill_chunks.items():
             seq = eng.sequences.get(sid)
             if seq is None or sid not in eng._prefilling:
@@ -300,11 +374,13 @@ class AsyncMixedRuntime:
         for s in decode:
             lane = lane_of[s.seq_id]
             dec_rows.append((s, lane))
-            qlens[lane] = 1
+            plan = ffwd_plan.get(s.seq_id)
+            q = 1 if plan is None else 1 + len(plan[1])
+            qlens[lane] = q
             emits[lane] = True
-            # extend(1) above made alloc.length = written + inflight + 1;
-            # the row writes (and attends from) the slot before it.
-            starts[lane] = eng.alloc.length(s.seq_id) - 1
+            # The bookings above made alloc.length = written + inflight
+            # + q; the row writes its q inputs from the slots before it.
+            starts[lane] = eng.alloc.length(s.seq_id) - q
             tables[lane] = eng.alloc.page_table_row(s.seq_id)
             temps[lane] = s.params.temperature
             top_k[lane] = s.params.top_k
@@ -312,7 +388,13 @@ class AsyncMixedRuntime:
             fsm = device_table_fsm(s.mask_fn)
             if fsm is not None:
                 _seat_fsm(fsm)
-            if s.seq_id in continuing:
+            if plan is not None:
+                # Fast-forward row: every input token is host-known (the
+                # anchor by forced determinism), so the carry is unused.
+                anchor, pre, ov_dev = plan
+                tokens[lane, :q] = [anchor] + pre
+                ov_fsm[lane] = ov_dev
+            elif s.seq_id in continuing:
                 use_carry[lane] = True
             else:
                 tokens[lane, 0] = (
@@ -391,8 +473,10 @@ class AsyncMixedRuntime:
                 log.exception("async pipeline salvage flush failed")
             for s, _lane in dec_rows:
                 if not s.done and s.seq_id in eng.sequences:
+                    plan = ffwd_plan.get(s.seq_id)
+                    booked = 1 if plan is None else 1 + len(plan[1])
                     eng.alloc.truncate(
-                        s.seq_id, eng.alloc.length(s.seq_id) - 1
+                        s.seq_id, eng.alloc.length(s.seq_id) - booked
                     )
             for sid, *_ in chk_rows:
                 eng._drop_admission(sid)
@@ -412,7 +496,13 @@ class AsyncMixedRuntime:
 
         from ..obs.attribution import prefill_attn_positions
 
-        dec_ctx = int(sum(int(starts[lane]) + 1 for _s, lane in dec_rows))
+        # Decode-lane composition sums use each row's true q (1 for a
+        # plain lane, 1 + run length for a fast-forward append); with no
+        # ffwd rows they reduce to the previous one-token formulas.
+        dec_q = int(sum(int(qlens[lane]) for _s, lane in dec_rows))
+        dec_ctx = int(sum(
+            int(starts[lane]) + int(qlens[lane]) for _s, lane in dec_rows
+        ))
         record_async_dispatch(
             decode_rows=len(dec_rows),
             prefill_tokens=n_prefill,
@@ -420,23 +510,41 @@ class AsyncMixedRuntime:
             depth=len(self._pending) + 1,
             attr=getattr(eng, "attr", None),
             attr_kw=dict(
-                q_tokens=len(dec_rows) + n_prefill,
+                q_tokens=dec_q + n_prefill,
                 kv_read_tokens=dec_ctx + int(sum(
                     d + c for _sid, _l, d, c, _f in chk_rows
                 )),
-                kv_write_tokens=len(dec_rows) + n_prefill,
-                attn_q_ctx=dec_ctx + int(sum(
+                kv_write_tokens=dec_q + n_prefill,
+                attn_q_ctx=int(sum(
+                    prefill_attn_positions(
+                        int(starts[lane]), int(qlens[lane])
+                    )
+                    for _s, lane in dec_rows
+                )) + int(sum(
                     prefill_attn_positions(d, c)
                     for _sid, _l, d, c, _f in chk_rows
                 )),
             ),
         )
+        n_forced = int(sum(len(p[1]) for p in ffwd_plan.values()))
+        if ffwd_plan:
+            from .decode_loop import record_ffwd_append
+
+            for s, _lane in dec_rows:
+                plan = ffwd_plan.get(s.seq_id)
+                if plan is not None:
+                    record_ffwd_append(
+                        s.seq_id, len(plan[1]),
+                        attr=getattr(eng, "attr", None),
+                        request_id=obs.flight.request_id_of(s.trace),
+                    )
         self._tick_id += 1
         obs.flight.record(
             "dispatch", op="mixed",
             decode_seq_ids=[s.seq_id for s, _ in dec_rows],
             prefill_seq_ids=[sid for sid, *_ in chk_rows],
             bucket=int(S), prefill_tokens=n_prefill,
+            forced_tokens=n_forced,
             budget=cfg.max_step_tokens,
             tick=self._tick_id, pipeline_pos=len(self._pending),
         )
@@ -456,8 +564,10 @@ class AsyncMixedRuntime:
         for s, lane in dec_rows:
             self._prev_lane[s.seq_id] = lane
             self._prev_emitted.add(s.seq_id)
+            plan = ffwd_plan.get(s.seq_id)
             self._inflight_toks[s.seq_id] = (
                 self._inflight_toks.get(s.seq_id, 0) + 1
+                + (0 if plan is None else len(plan[1]))
             )
         for sid, lane, _done, _c, finishing in chk_rows:
             self._prev_lane[sid] = lane
@@ -467,6 +577,7 @@ class AsyncMixedRuntime:
             toks_d=toks_d,
             decode=[(s.seq_id, lane) for s, lane in dec_rows],
             chunks=chk_rows,
+            ffwd={sid: plan[1] for sid, plan in ffwd_plan.items()},
             t_disp=t_disp,
             tick_id=self._tick_id,
         ))
@@ -493,30 +604,44 @@ class AsyncMixedRuntime:
         decode_out, prefill_out = self._results
         produced = 0
         for sid, lane in tick.decode:
-            self._dec_inflight(sid)
+            pre = tick.ffwd.get(sid, [])
+            n_toks = 1 + len(pre)
+            for _ in range(n_toks):
+                self._dec_inflight(sid)
             s = eng.sequences.get(sid)
             if s is None or s.done:
                 # Stop/EOS detection lagged a tick: this row finished at
                 # an earlier commit (or was dropped) while this dispatch
-                # was in flight. Its token is discarded; the page booking
-                # was already rolled back by the done-path truncate.
-                obs.ASYNC_OVERSHOOT_TOKENS.inc()
+                # was in flight. Its tokens are discarded; the page
+                # booking was already rolled back by the done-path
+                # truncate.
+                obs.ASYNC_OVERSHOOT_TOKENS.inc(n_toks)
                 continue
-            tok = int(sampled[lane])
             dspan = s.decode_span
-            try:
-                eng._accept_token(s, tok)
-            except Exception:  # noqa: BLE001 - raising stream callback
-                # Row-local isolation without propagation, exactly like
-                # step_mixed: the reap path surfaces "error"; raising
-                # here would lose the same tick's other rows.
-                s.done = True
-                s.finish_reason = s.finish_reason or "error"
-            decode_out.setdefault(sid, []).append(tok)
-            produced += 1
+            accepted = 0
+            # Fast-forward pre-accepts land first (they precede the
+            # sampled token in the append), so the stop-string/EOS scan
+            # runs over the run in order and a mid-run stop discards the
+            # tail as overshoot.
+            for tok in list(pre) + [int(sampled[lane])]:
+                if s.done:
+                    obs.ASYNC_OVERSHOOT_TOKENS.inc()
+                    continue
+                try:
+                    eng._accept_token(s, tok)
+                except Exception:  # noqa: BLE001 - raising stream callback
+                    # Row-local isolation without propagation, exactly
+                    # like step_mixed: the reap path surfaces "error";
+                    # raising here would lose the same tick's other rows.
+                    s.done = True
+                    s.finish_reason = s.finish_reason or "error"
+                decode_out.setdefault(sid, []).append(tok)
+                accepted += 1
+            produced += accepted
             if dspan is not None:
                 dspan.child(
-                    "mixed_step", tick.t_disp, time.perf_counter(), tokens=1
+                    "ffwd_step" if pre else "mixed_step",
+                    tick.t_disp, time.perf_counter(), tokens=accepted,
                 )
             if s.done:
                 # Roll bookings (including any still-in-flight lookahead

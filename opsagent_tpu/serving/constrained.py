@@ -309,6 +309,11 @@ NATIVE_TABLE_BUDGET = int(
     os.environ.get("OPSAGENT_FSM_NATIVE_BUDGET", 64_000_000)
 )
 
+# Longest forced run the fast-forward path will splice in one dispatch.
+# Bounds both the precompute loop (a cyclic grammar could force forever)
+# and the q_len of the multi-token append, which must fit a mixed bucket.
+FORCED_RUN_CAP = int(os.environ.get("OPSAGENT_FFWD_RUN_CAP", 12))
+
 
 class TokenFSM:
     """Lifts a byte DFA to token-level masks over a tokenizer vocabulary.
@@ -327,6 +332,9 @@ class TokenFSM:
         self.vocab_size = len(token_bytes)
         self._mask_cache: dict[int, np.ndarray] = {}
         self._dense: tuple[np.ndarray, np.ndarray] | None = None
+        self._forced: dict[int, int | None] = {}
+        self._forced_runs: dict[int, list[int]] = {}
+        self._forced_table: tuple[np.ndarray, np.ndarray] | None = None
         self._lens = np.array([len(tb) for tb in token_bytes], np.int32)
         maxlen = max(1, int(self._lens.max()))
         self._bytes = np.zeros((self.vocab_size, maxlen), np.int32)
@@ -423,6 +431,71 @@ class TokenFSM:
         self._dense = (mask, dest)
         return self._dense
 
+    # -- Forced-token fast-forward ------------------------------------
+    # A state whose mask admits exactly ONE token is a speculator with
+    # acceptance = 1.0 by construction: the masked sample produces that
+    # token at ANY temperature (softmax over a single admissible logit),
+    # so the engine may emit it without a forward pass. Runs of such
+    # states — JSON punctuation, known key names, enum close-quotes —
+    # are precomputed here and spliced in one multi-token dispatch.
+
+    def forced_token(self, state: int) -> int | None:
+        """The single legal token at ``state``, or None when the mask
+        admits zero or several. Cached per state."""
+        if state in self._forced:
+            return self._forced[state]
+        tok: int | None = None
+        if state >= 0:
+            idx = np.flatnonzero(self.mask_for_state(state))
+            if idx.size == 1:
+                tok = int(idx[0])
+        self._forced[state] = tok
+        return tok
+
+    def forced_run(self, state: int) -> list[int]:
+        """The maximal run of forced tokens starting at ``state``, capped
+        at FORCED_RUN_CAP. A forced eos ends the run (inclusive) — there
+        is no state after eos. Empty when the state's mask is not a
+        singleton. Cached per state."""
+        cached = self._forced_runs.get(state)
+        if cached is not None:
+            return cached
+        run: list[int] = []
+        st = state
+        while len(run) < FORCED_RUN_CAP:
+            tok = self.forced_token(st)
+            if tok is None:
+                break
+            run.append(tok)
+            if tok == self.eos_id:
+                break
+            st = self.advance(st, tok)
+            if st < 0:  # defensive: a forced token never leaves the DFA
+                break
+        self._forced_runs[state] = run
+        return run
+
+    def forced_run_table(self) -> tuple[np.ndarray, np.ndarray] | None:
+        """Dense ([S+1, L] int32 token-ids, [S+1] int32 run-lengths) in
+        the device-table numbering of ``dense_tables`` (row 0 = FREE
+        sentinel, no run; DFA state s at row s+1), for device residency
+        alongside the mask/dest tables. None under the same memory
+        budget gate. Built once and cached."""
+        if self._forced_table is not None:
+            return self._forced_table
+        if self.dense_tables() is None:
+            return None
+        S = self.dfa.num_states
+        L = max(1, FORCED_RUN_CAP)
+        toks = np.zeros((S + 1, L), np.int32)
+        lens = np.zeros((S + 1,), np.int32)
+        for s in range(S):
+            run = self.forced_run(s)
+            lens[s + 1] = len(run)
+            toks[s + 1, : len(run)] = run
+        self._forced_table = (toks, lens)
+        return self._forced_table
+
 
 class JsonConstraint:
     """Engine-facing ``mask_fn``: tracks DFA state incrementally across the
@@ -434,13 +507,25 @@ class JsonConstraint:
         self._consumed = 0
 
     def __call__(self, tokens: list[int]) -> np.ndarray:
+        return self.fsm.mask_for_state(self.dfa_state(tokens))
+
+    def dfa_state(self, tokens: list[int]) -> int:
+        """The DFA state after consuming ``tokens`` — the same
+        incremental sync ``__call__`` performs, without building a mask.
+        The fast-forward planner uses this to ask for forced runs."""
         if len(tokens) < self._consumed:  # new sequence reusing the object
             self._state, self._consumed = self.fsm.dfa.start, 0
         for tok in tokens[self._consumed:]:
             if tok != self.fsm.eos_id:
                 self._state = self.fsm.advance(self._state, tok)
         self._consumed = len(tokens)
-        return self.fsm.mask_for_state(self._state)
+        return self._state
+
+    def forced_run(self, tokens: list[int]) -> list[int]:
+        """The forced run from the state after ``tokens`` (possibly
+        empty; capped at FORCED_RUN_CAP; a forced eos ends it)."""
+        st = self.dfa_state(tokens)
+        return [] if st < 0 else self.fsm.forced_run(st)
 
 
 def device_table_fsm(mask_fn) -> TokenFSM | None:

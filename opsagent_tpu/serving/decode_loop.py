@@ -128,6 +128,31 @@ def record_async_commit(overlapped: bool, depth_after: int) -> None:
     obs.ASYNC_INFLIGHT_DEPTH.set(depth_after)
 
 
+def record_ffwd_append(
+    seq_id: int, run_len: int, attr=None, request_id: str | None = None,
+) -> None:
+    """One forced-token run spliced by the grammar fast-forward path:
+    ``run_len`` tokens emitted straight from the constrained FSM's
+    singleton masks, each of which would otherwise have cost its own
+    forward pass. Counts the run, the tokens, and the skipped dispatches,
+    drops a ``ffwd`` flight event, and charges the skipped dispatches to
+    the attribution ledger at zero weight-stream cost (the consuming
+    dispatch's q_tokens already carry the run's real KV/attention work)
+    so ``opsagent_attr_dispatches_total`` and the goodput ledger stay
+    honest about how many dispatches the grammar replaced."""
+    from .. import obs
+
+    obs.FFWD_RUNS.inc()
+    obs.FFWD_TOKENS.inc(run_len)
+    obs.FFWD_SKIPPED_DISPATCHES.inc(run_len)
+    obs.flight.record(
+        "ffwd", seq_id=seq_id, run_len=run_len, request_id=request_id,
+    )
+    if attr is not None:
+        for _ in range(run_len):
+            _record_attr("ffwd", attr, dict(weight_streams=0.0))
+
+
 def mixed_step_carry(
     params: Any,
     cfg: ModelConfig,

@@ -243,6 +243,17 @@ class EngineConfig:
     # config). Forces the xla paged-attention backend (the Pallas kernels
     # stream raw pages); unsupported for MLA latent caches.
     kv_quantize: str = ""
+    # Grammar-accelerated decoding: when a constrained row's FSM state
+    # admits exactly ONE legal token (JSON punctuation, known key names,
+    # enum close-quotes), emit the whole forced run with NO per-token
+    # forward pass — spliced as one multi-token append through the mixed
+    # program's q_len>1 path. Acceptance = 1.0 by construction (the
+    # masked sample can only produce the forced token), so greedy output
+    # is byte-identical with the flag off; the skipped dispatches are
+    # exact counts reported by opsagent_ffwd_*_total. Rows without dense
+    # device FSM tables (hosted masks, budget-exceeded schemas), with
+    # logprobs, or with logit bias are ineligible and decode normally.
+    grammar_ffwd: bool = True
     # Compile every serving program (all prefill buckets + decode) at
     # construction time so the first real request never pays XLA compile
     # (the TTFT budget is 500 ms; a cold bucket compile is tens of seconds).
@@ -749,6 +760,9 @@ class Engine:
         # (the host-array variant compiles only once, inside warmup).
         self._async_carry = None
         self._async_fsm_carry = None
+        # Constrained sequences already counted as ffwd-ineligible (the
+        # fallback reason fires once per sequence, not once per tick).
+        self._ffwd_noted: set[int] = set()
         # Wall-clock stamp of the last mixed dispatch's enqueue return,
         # shared by the sync and async tick paths: the gap to the next
         # dispatch is the opsagent_step_host_gap_seconds observable.
@@ -768,14 +782,18 @@ class Engine:
         "bench-spec": frozenset(
             {"prefill", "sample", "decode_greedy", "spec"}
         ),
+        # "fsm" rides along: sessions workloads carry schema-constrained
+        # rows since the grammar fast-forward bench, and a constrained
+        # row's first block dispatch must not compile under load.
         "sessions": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
-            "decode_greedy", "mixed", "mixed_async", "offload",
+            "decode_greedy", "mixed", "mixed_async", "fsm", "ffwd",
+            "offload",
         }),
         "full": frozenset({
             "prefill", "prefill_prefix", "prefill_batched", "sample",
             "decode_single", "logprobs", "decode_greedy", "decode_sampled",
-            "fsm", "spec", "mixed", "mixed_async", "offload",
+            "fsm", "spec", "mixed", "mixed_async", "ffwd", "offload",
         }),
     }
 
@@ -941,6 +959,51 @@ class Engine:
                 self._async_carry = a_carry
                 self._async_fsm_carry = a_fsm
                 toks = a_carry
+            # Grammar fast-forward programs: the multi-token forced-run
+            # append reuses _mixed_carry_jit with dense FSM tables and
+            # FRESH host carries every call (the sync ffwd path never
+            # chains device carries), so both the host-array and chained
+            # variants must exist PER BUCKET. Warmed independently of
+            # async_depth — depth-1 engines take this path too. At
+            # depth>1 with "fsm" in the level the mixed_async block above
+            # already compiled these entries; re-dispatching is a cache
+            # hit, not a recompile.
+            if (
+                "ffwd" in progs and self.cfg.mixed_batching
+                and self.cfg.grammar_ffwd
+            ):
+                try:
+                    from .constrained import (
+                        TOOLPROMPT_SCHEMA, json_constraint,
+                    )
+
+                    con = json_constraint(self.tokenizer, TOOLPROMPT_SCHEMA)
+                    if con.fsm.dense_tables() is not None:
+                        fm, fd = self._fsm_device_tables(con.fsm)
+                        zb = jnp.zeros((B,), bool)
+                        for sb in self.cfg.mixed_buckets:
+                            f_carry = jnp.zeros((B,), jnp.int32)
+                            f_fsm = jnp.zeros((B,), jnp.int32)
+                            for _ in range(2):
+                                self._sample_key, sub = jax.random.split(
+                                    self._sample_key
+                                )
+                                f_carry, self.cache, f_fsm = (
+                                    self._mixed_carry_jit(
+                                        self.params,
+                                        jnp.zeros((B, sb), jnp.int32),
+                                        zb, f_carry, zi, zi, zb,
+                                        self.cache, dropB,
+                                        sub, zf, zi, of,
+                                        fsm_mask=fm, fsm_dest=fd,
+                                        carry_fsm=f_fsm, ov_fsm=zi,
+                                    )
+                                )
+                                toks = f_carry
+                except Exception:  # noqa: BLE001 - warmup is best-effort
+                    log.exception(
+                        "grammar-ffwd FSM warmup failed (non-fatal)"
+                    )
             if "decode_single" in progs:
                 self._sample_key, sub = jax.random.split(self._sample_key)
                 _, self.cache = self._decode_sample_jit(
@@ -1778,6 +1841,261 @@ class Engine:
             self._observe_occupancy()
             return decode_out, prefill_out
 
+    # -- grammar fast-forward (forced-token runs) ----------------------------
+    def _note_ffwd_ineligible(self, s: Sequence) -> None:
+        """Count a constrained row that cannot fast-forward (hosted mask /
+        tables over budget / logprobs / logit bias) — once per sequence,
+        under the fallback reason that separates "can't fast-forward"
+        from "can't async"."""
+        if s.seq_id in self._ffwd_noted:
+            return
+        self._ffwd_noted.add(s.seq_id)
+        obs.ASYNC_FALLBACKS.inc(reason="ffwd_ineligible")
+
+    def _ffwd_candidate(self, s: Sequence) -> tuple[Any, list[int]] | None:
+        """(fsm, forced run D) when this row can splice a forced run right
+        now, else None. The dispatch inputs are ``[last_token] + D`` and
+        the masked sample after D is itself deterministic — eos when the
+        run ended there (trimmed off D: a masked sample at an eos-only
+        state yields eos at ANY temperature), or D's forced successor when
+        the cap cut the run short. D is clamped so the append (D plus the
+        sampled token) never overshoots max_tokens or the largest mixed
+        bucket."""
+        if s.mask_fn is None or s.done or not s.tokens:
+            return None
+        if s.params.logprobs or self._needs_bias(s):
+            self._note_ffwd_ineligible(s)
+            return None
+        from .constrained import device_table_fsm
+
+        fsm = device_table_fsm(s.mask_fn)
+        if fsm is None:
+            self._note_ffwd_ineligible(s)
+            return None
+        run = s.mask_fn.forced_run(s.tokens)
+        if run and run[-1] == fsm.eos_id:
+            run = run[:-1]
+        room = s.params.max_tokens - len(s.tokens) - 1
+        run = run[: max(0, min(room, self.cfg.mixed_buckets[-1] - 1))]
+        if not run:
+            return None
+        return fsm, run
+
+    def ffwd_step(self, seq_ids: list[int]) -> dict[int, list[int]]:
+        """Grammar fast-forward: for constrained rows whose current FSM
+        state forces a run of singleton-mask tokens, splice the whole run
+        into the paged KV as ONE multi-token append (the q_len>1 path the
+        mixed program already serves for prefill chunks) and sample only
+        the token AFTER the run — every forced token skips a full forward
+        pass. Greedy output is byte-identical with the feature off: a
+        masked sample over a singleton support produces that token at any
+        temperature.
+
+        The pre-scan never disturbs the block pipeline: rows with
+        device-resident in-flight tokens have stale host token lists and
+        are skipped (no flush-thrash when nothing is forced). Only when a
+        settled row has a forced run does this settle the pipelines and
+        dispatch. Returns {seq_id: accepted tokens} (empty when nothing
+        was eligible)."""
+        with self.lock:
+            if not (self.cfg.grammar_ffwd and self.cfg.mixed_batching):
+                return {}
+
+            def scan(skip_inflight: bool) -> list[tuple]:
+                out = []
+                for sid in seq_ids:
+                    s = self.sequences.get(sid)
+                    if s is None or s.done:
+                        continue
+                    # A lane ASSIGNMENT alone does not stale the host
+                    # token list — only booked steps still in flight do
+                    # (the carry's pending write slot is a page booking,
+                    # not a token). Scanning settled lane rows is what
+                    # lets ffwd engage between blocks without flushing
+                    # speculatively.
+                    if skip_inflight and (
+                        sid in self._inflight_steps
+                        or self._async._inflight_toks.get(sid, 0)
+                    ):
+                        continue
+                    cand = self._ffwd_candidate(s)
+                    if cand is not None:
+                        out.append((s, *cand))
+                return out
+
+            if not scan(True):
+                return {}
+            self._async_settle()
+            while self._inflight or self._lane_of:
+                try:
+                    self._flush_and_invalidate()
+                except Exception:  # noqa: BLE001 - raising stream callback
+                    log.exception(
+                        "stream callback raised while settling pipelined "
+                        "state for a ffwd dispatch; row isolated"
+                    )
+            # Re-scan on settled host state: pulled blocks appended tokens
+            # (and may have finished rows) during the flush.
+            cands = scan(False)
+            if not cands:
+                return {}
+            # One shared table set per dispatch: keep the first fsm's
+            # group, the rest retry next tick.
+            fsm0 = cands[0][1]
+            cands = [
+                c for c in cands if c[1] is fsm0
+            ][: self.cfg.max_batch_size]
+            rows: list[tuple[Sequence, list[int]]] = []
+            for s, _fsm, run in cands:
+                try:
+                    self.alloc.extend(s.seq_id, 1 + len(run))
+                except OutOfPages:
+                    # Roll back partially-grabbed pages and leave the row
+                    # to the normal decode path, which finishes it as
+                    # "length" when the pool is truly dry.
+                    self.alloc.truncate(
+                        s.seq_id, self.alloc.length(s.seq_id)
+                    )
+                    continue
+                rows.append((s, run))
+            if not rows:
+                return {}
+            B = self.cfg.max_batch_size
+            S = self._mixed_bucket(max(1 + len(r) for _, r in rows))
+            tokens = np.full((B, S), self.tokenizer.pad_id, np.int32)
+            starts = np.zeros((B,), np.int32)
+            qlens = np.zeros((B,), np.int32)
+            emits = np.zeros((B,), bool)
+            ov_fsm = np.zeros((B,), np.int32)  # 0 = FREE sentinel row
+            tables = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
+            temps = np.zeros((B,), np.float32)
+            top_k = np.zeros((B,), np.int32)
+            top_p = np.ones((B,), np.float32)
+            for i, (s, run) in enumerate(rows):
+                q = 1 + len(run)
+                tokens[i, :q] = [s.tokens[-1]] + run
+                # extend above made alloc.length = written + q; the row
+                # writes its q inputs from the written offset.
+                starts[i] = self.alloc.length(s.seq_id) - q
+                qlens[i] = q
+                emits[i] = True
+                tables[i] = self.alloc.page_table_row(s.seq_id)
+                # The masked sample applies the state AFTER the appended
+                # run (+1: device-table row 0 is the FREE sentinel).
+                ov_fsm[i] = s.mask_fn.dfa_state(s.tokens + run) + 1
+                temps[i] = s.params.temperature
+                top_k[i] = s.params.top_k
+                top_p[i] = s.params.top_p
+            fm, fd = self._fsm_device_tables(fsm0)
+            zb = jnp.zeros((B,), bool)
+            zi = jnp.zeros((B,), jnp.int32)
+            perf = get_perf_stats()
+            t_disp = time.perf_counter()
+            try:
+                dev_out: list = []
+                with annotate("engine.ffwd_step"), \
+                        device_timer("ffwd_step", dev_out), self.mesh_ctx():
+                    self._sample_key, sub = jax.random.split(self._sample_key)
+                    toks_d, self.cache, _fsm_d = self._mixed_carry_jit(
+                        self.params,
+                        jnp.asarray(tokens),
+                        zb,  # use_carry: all rows override from host
+                        zi,  # carry tokens (unused at use_carry=False)
+                        jnp.asarray(starts),
+                        jnp.asarray(qlens),
+                        jnp.asarray(emits),
+                        self.cache,
+                        jnp.asarray(tables),
+                        sub,
+                        jnp.asarray(temps),
+                        jnp.asarray(top_k),
+                        jnp.asarray(top_p),
+                        fsm_mask=fm, fsm_dest=fd,
+                        carry_fsm=zi, ov_fsm=jnp.asarray(ov_fsm),
+                    )
+                    dev_out.append(toks_d)
+                self._mixed_gap_stamp = time.perf_counter()
+                sampled = np.asarray(toks_d)
+            except Exception:
+                # Nothing was accepted: roll every booking back to
+                # written truth before surfacing the dispatch error.
+                for s, _run in rows:
+                    if not s.done:
+                        self.alloc.truncate(s.seq_id, self._host_written(s))
+                raise
+            measured_s = time.perf_counter() - t_disp
+            perf.record_metric(
+                "engine.ffwd_dispatch", measured_s * 1e3, "ms"
+            )
+            obs.DECODE_DISPATCHES.inc(kind="ffwd")
+            n_forced = int(sum(len(r) for _, r in rows))
+            q_total = n_forced + len(rows)
+            self.attr.dispatch(
+                "ffwd_append",
+                q_tokens=q_total,
+                kv_read_tokens=int(sum(
+                    int(starts[i]) + int(qlens[i])
+                    for i in range(len(rows))
+                )),
+                kv_write_tokens=q_total,
+                attn_q_ctx=int(sum(
+                    obs.attribution.prefill_attn_positions(
+                        int(starts[i]), int(qlens[i])
+                    )
+                    for i in range(len(rows))
+                )),
+                measured_s=measured_s,
+            )
+            obs.flight.record(
+                "dispatch", op="ffwd",
+                decode_seq_ids=[s.seq_id for s, _ in rows],
+                bucket=int(S), forced_tokens=n_forced,
+            )
+            from .decode_loop import record_ffwd_append
+
+            decode_out: dict[int, list[int]] = {}
+            produced = 0
+            for i, (s, run) in enumerate(rows):
+                accepted: list[int] = []
+                dspan = s.decode_span
+                try:
+                    for t in run:
+                        if s.done:
+                            break
+                        self._accept_token(s, t)
+                        accepted.append(t)
+                    if not s.done:
+                        tok = int(sampled[i])
+                        self._accept_token(s, tok)
+                        accepted.append(tok)
+                except Exception:  # noqa: BLE001 - raising stream callback
+                    # Row-local isolation, same contract as step_mixed:
+                    # the reap path surfaces finish_reason "error".
+                    s.done = True
+                    s.finish_reason = s.finish_reason or "error"
+                if s.done:
+                    # Stop string / EOS / max_tokens (or a raising
+                    # callback) landed mid-append: the tail of the booked
+                    # run is dead content — roll back to written truth.
+                    self.alloc.truncate(s.seq_id, self._host_written(s))
+                n_ff = min(len(accepted), len(run))
+                if n_ff:
+                    record_ffwd_append(
+                        s.seq_id, n_ff, attr=self.attr,
+                        request_id=obs.flight.request_id_of(s.trace),
+                    )
+                if dspan is not None:
+                    dspan.child(
+                        "ffwd_step", t_disp, time.perf_counter(),
+                        tokens=len(accepted),
+                    )
+                decode_out[s.seq_id] = accepted
+                produced += len(accepted)
+            if produced:
+                perf.record_metric("engine.decode_tokens", produced, "tok")
+            self._observe_occupancy()
+            return decode_out
+
     def _sampling_arrays(
         self, seqs: list[Sequence | None], B: int
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray | None]:
@@ -2109,6 +2427,14 @@ class Engine:
             from .constrained import device_table_fsm
 
             return device_table_fsm(s.mask_fn) is None
+
+    def note_ffwd_ineligible(self, seq_id: int) -> None:
+        """Scheduler-facing form of ``_note_ffwd_ineligible``: count a
+        row that a hosted-lane fallback just made ffwd-ineligible."""
+        with self.lock:
+            s = self.sequences.get(seq_id)
+            if s is not None:
+                self._note_ffwd_ineligible(s)
 
     def async_row_fsm(self, seq_id: int):
         """The dense-table TokenFSM behind this row's mask, or None. The
@@ -2995,6 +3321,7 @@ class Engine:
         written back by a decode step)."""
         with self.lock:
             seq = self.sequences.pop(seq_id)
+            self._ffwd_noted.discard(seq_id)
             self.alloc.free(seq_id, tokens=seq.prompt_ids + seq.tokens[:-1])
             obs.flight.record(
                 "finish", seq_id=seq_id, tokens=len(seq.tokens),

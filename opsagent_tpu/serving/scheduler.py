@@ -378,7 +378,26 @@ class Scheduler:
         if not getattr(eng.cfg, "mixed_batching", False):
             return False
         if getattr(eng.cfg, "async_depth", 1) > 1:
-            return self._async_mixed_tick()
+            if self._async_mixed_tick():
+                return True
+            # The lookahead lane passed on the tick (pure decode, hosted
+            # rows, nothing admitting): rows falling back to the block
+            # pipeline still get the grammar fast-forward below — the
+            # async planner only covers rows IT dispatches.
+            if getattr(eng.cfg, "grammar_ffwd", False) and self._running:
+                eng.ffwd_step(sorted(self._running))
+            return False
+        # Grammar fast-forward (depth-1 sync lane): splice forced-token
+        # runs for constrained rows BEFORE the hosted-row bail below routes
+        # the tick to the split path — constrained rows are always
+        # mixed_hosted, so this is their only mixed-family entry point.
+        # The engine pre-scans without touching the block pipeline: rows
+        # with device-resident in-flight tokens are left alone (their host
+        # token lists are stale), so the fast-forward engages at
+        # settle/admission boundaries; the async lane (depth > 1) engages
+        # at every plan point it dispatches.
+        if getattr(eng.cfg, "grammar_ffwd", False) and self._running:
+            eng.ffwd_step(sorted(self._running))
         if not self._prefilling:
             return False
         for sid in list(self._running) + list(self._prefilling):
@@ -456,7 +475,23 @@ class Scheduler:
         # (parking, warmup, sync-lane entry points) since the last tick.
         _, p_out = eng.async_take_results()
         self._fold_async_prefill(p_out)
-        if not self._prefilling and not eng.async_pending():
+        # Grammar fast-forward keeps dense-table constrained rows in the
+        # async lane even for PURE decode: the planner splices forced
+        # runs at every dispatch point, which the block pipeline (host
+        # token lists stale behind in-flight blocks) cannot do. Per-tick
+        # host overhead loses to block batching only when forced states
+        # are rare — a schema-constrained row is exactly where they are
+        # not.
+        ffwd_decode = (
+            getattr(eng.cfg, "grammar_ffwd", False)
+            and any(
+                sid in eng.sequences and not eng.sequences[sid].done
+                and eng.async_row_fsm(sid) is not None
+                for sid in self._running
+            )
+        )
+        if not self._prefilling and not eng.async_pending() \
+                and not ffwd_decode:
             return False
         # Hosted rows (and mixed-schema constrained batches) route the
         # tick to the sync lanes — settle the pipeline first so the split
@@ -475,6 +510,12 @@ class Scheduler:
                 obs.ASYNC_FALLBACKS.inc(
                     reason="hosted" if hosted else "fsm_mismatch"
                 )
+                if hosted and getattr(eng.cfg, "grammar_ffwd", False):
+                    # A hosted row (host mask / no dense tables /
+                    # logprobs / bias) also cannot fast-forward; the
+                    # distinct reason label separates "can't ffwd" from
+                    # "can't async" (counted once per sequence).
+                    eng.note_ffwd_ineligible(sid)
                 _, p_out = eng.async_drain()
                 self._fold_async_prefill(p_out)
                 return False
@@ -502,15 +543,18 @@ class Scheduler:
             budget -= c
             rows_left -= 1
         if not chunks:
-            if not eng.async_pending():
+            if not ffwd_decode:
+                if not eng.async_pending():
+                    return False
+                # Every admitting prompt is fully planned (or the budget
+                # is spent) and only commits remain: settle the pipeline
+                # so the completions land, then let the next tick route
+                # pure decode to the block pipeline.
+                _, p_out = eng.async_drain()
+                self._fold_async_prefill(p_out)
+                return True
+            if not decode_ids:
                 return False
-            # Every admitting prompt is fully planned (or the budget is
-            # spent) and only commits remain: settle the pipeline so the
-            # completions land, then let the next tick route pure decode
-            # to the block pipeline.
-            _, p_out = eng.async_drain()
-            self._fold_async_prefill(p_out)
-            return True
         try:
             _, p_out = eng.step_mixed_async(decode_ids, chunks)
         except Exception as e:  # noqa: BLE001 - engine cleaned up already

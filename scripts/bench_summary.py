@@ -182,6 +182,17 @@ def terminal_summary(paths: list[str]) -> int:
             f"{e.get('sync_tok_s_chip', 0)}; outputs identical: "
             f"{e.get('outputs_identical')}"
         )
+    sffwd = [d for d in tpu if d["metric"].startswith("sessions_ffwd")]
+    if sffwd:
+        d = sffwd[-1]
+        e = d.get("extra", {})
+        frac = e.get("forced_fraction", 0) or 0
+        print(
+            f"ffwd A/B: tok/s/chip {d['value']} (on) vs "
+            f"{e.get('off_tok_s_chip', 0)} (off); forced fraction "
+            f"{frac:.1%} ({e.get('skipped_dispatches', 0)} dispatches "
+            f"skipped); outputs identical: {e.get('outputs_identical')}"
+        )
     soff = [d for d in tpu if d["metric"].startswith("sessions_offload")]
     if soff:
         e = soff[-1].get("extra", {})

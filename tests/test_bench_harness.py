@@ -255,6 +255,42 @@ def test_sessions_offload_mode_reports_ab_decision_numbers():
     assert e["restored_tokens"] > 0
 
 
+def test_sessions_ffwd_mode_reports_ab_numbers():
+    """OPSAGENT_BENCH_MODE=sessions-ffwd (the tier-1-safe fast-lane form
+    of the grammar fast-forward A/B stage: CPU, tiny model, small N) must
+    run schema-constrained sessions with the forced-token fast-forward ON
+    then OFF against one engine and emit BOTH phases in ONE JSON line.
+    The on-phase must actually skip forward passes (skipped dispatches
+    and forced fraction are exact counts, not chip-dependent) and — same
+    greedy seeds — the two phases' output text must be byte-identical:
+    the grammar changes WHEN tokens are computed, never WHICH tokens."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "sessions-ffwd",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("sessions_ffwd[")
+    assert parsed["unit"] == "tok/s/chip"
+    e = parsed["extra"]
+    assert e["errors"] == 0
+    # Both phases measured and distinguishable.
+    assert e["p50_ttft_ms"] > 0 and e["off_p50_ttft_ms"] > 0
+    assert "tok_s_chip_delta" in e
+    # The on-phase actually fast-forwarded: whole singleton-mask runs
+    # landed without a forward pass; the off-phase cannot have.
+    assert e["skipped_dispatches"] > 0
+    assert e["ffwd_tokens"] > 0 and e["ffwd_runs"] > 0
+    assert 0 < e["forced_fraction"] <= 1
+    assert e["off_skipped_dispatches"] == 0
+    # ...without changing a single output byte.
+    assert e["outputs_identical"] is True
+
+
 def test_fleet_affinity_mode_reports_ab_numbers():
     """OPSAGENT_BENCH_MODE=fleet-affinity (the tier-1-safe fast-lane form
     of the fleet A/B stage: CPU, tiny model, 2 in-process replicas behind

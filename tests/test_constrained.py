@@ -246,3 +246,81 @@ class TestFSMCacheBounds:
         # Masks still work via the lazy path.
         mask = fsm.mask_for_state(dfa.start)
         assert mask[ord("t")] and mask[ord("f")] and not mask[ord("x")]
+
+
+class TestForcedRuns:
+    """Fast-forward precompute: states whose legal-token mask is a singleton
+    expose the forced token (and whole forced runs) without a forward pass."""
+
+    STATUS_SCHEMA = {
+        "type": "object",
+        "properties": {"status": {"enum": ["ok"]}},
+        "required": ["status"],
+    }
+
+    @pytest.fixture(scope="class")
+    def con(self):
+        return json_constraint(ByteTokenizer(), self.STATUS_SCHEMA)
+
+    def test_forced_token_singleton_only(self, con):
+        fsm, dfa = con.fsm, con.fsm.dfa
+        # Object punctuation: '{' is the only way to open the document.
+        assert fsm.forced_token(dfa.start) == ord("{")
+        # After '{"status"' both ':' and whitespace are live: not forced.
+        st = dfa.run(dfa.start, b'{"status"')
+        assert fsm.forced_token(st) is None
+        assert np.flatnonzero(fsm.mask_for_state(st)).size > 1
+
+    def test_known_key_name_is_forced(self, con):
+        fsm, dfa = con.fsm, con.fsm.dfa
+        st = dfa.run(dfa.start, b'{"')
+        run = fsm.forced_run(st)
+        assert bytes(run) == b'status"'
+        # Walking the run by hand hits singleton masks at every step.
+        for tok_id in run:
+            assert fsm.forced_token(st) == tok_id
+            st = fsm.advance(st, tok_id)
+        assert st >= 0
+
+    def test_enum_close_quote_is_forced(self, con):
+        fsm, dfa = con.fsm, con.fsm.dfa
+        st = dfa.run(dfa.start, b'{"status": "o')
+        assert bytes(fsm.forced_run(st)) == b'k"'
+
+    def test_accept_state_run_terminates_with_eos(self, con):
+        tok = ByteTokenizer()
+        fsm, dfa = con.fsm, con.fsm.dfa
+        st = dfa.run(dfa.start, b'{"status": "ok"}')
+        assert dfa.accept[st]
+        assert fsm.forced_run(st) == [tok.eos_id]
+        assert fsm.forced_token(st) == tok.eos_id
+
+    def test_run_capped_at_forced_run_cap(self):
+        from opsagent_tpu.serving import constrained as C
+
+        con = json_constraint(ByteTokenizer(), {"enum": ["a" * 40]})
+        fsm, dfa = con.fsm, con.fsm.dfa
+        st = dfa.run(dfa.start, b'"a')
+        run = fsm.forced_run(st)
+        assert len(run) == C.FORCED_RUN_CAP
+        assert bytes(run) == b"a" * C.FORCED_RUN_CAP
+
+    def test_forced_run_table_matches_scalar_api(self, con):
+        from opsagent_tpu.serving import constrained as C
+
+        fsm = con.fsm
+        toks, lens = fsm.forced_run_table()
+        n_states = fsm.dfa.next.size // 256
+        assert toks.shape == (n_states + 1, C.FORCED_RUN_CAP)
+        assert lens.shape == (n_states + 1,)
+        assert lens[0] == 0  # row 0 is the FREE sentinel: nothing forced
+        for s in range(n_states):  # device row s+1 mirrors DFA state s
+            assert list(toks[s + 1, : lens[s + 1]]) == fsm.forced_run(s)
+
+    def test_constraint_level_forced_run_tracks_tokens(self, con):
+        toks = list(b'{"status": "o')
+        assert bytes(con.forced_run(toks)) == b'k"'
+        # Incremental state must survive interleaved mask queries.
+        con(toks)
+        assert bytes(con.forced_run(toks + [ord("k")])) == b'"'
+        assert con.forced_run(list(b'{"status"')) == []
