@@ -76,6 +76,12 @@ OPSAGENT_BENCH_MODE=agent runs the north-star agent shape instead:
 multi-turn ReAct sessions (observation-as-user-message, full-history
 resend) with the prefix cache on, reporting p50 client TTFT per
 tool-call turn and the prefix-hit rate.
+OPSAGENT_BENCH_MODE=agent-conveyor trains the tiny BPE agent
+in-process (seconds on CPU), serves the checkpoint, and runs the
+scripted tool episode with conveyor mid-decode tool launches on vs off
+— p50 episode wall, overlap seconds banked behind decode, early-launch
+count, the byte-identical-transcript verdict, and the
+zero-post-warmup-compiles invariant for both phases.
 OPSAGENT_BENCH_MODE=cold-start runs the snapshot/restore A/B
 (serving/snapshot): fresh-init request-ready vs Engine.from_snapshot
 request-ready against empty compile caches, with byte-identical greedy
@@ -512,6 +518,16 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         220, "agent-turns",
     ) if on_tpu else None
+    # Conveyor tool-overlap A/B: the trained tiny agent's scripted tool
+    # episodes with early mid-decode tool launches on vs off — p50
+    # episode wall, overlap banked per turn, early-launch count, and the
+    # byte-identical-transcript verdict. Trains its own checkpoint
+    # in-process, so it runs on CPU too (the only stage besides the
+    # default preset that does).
+    rconvey = stage(
+        {"OPSAGENT_BENCH_MODE": "agent-conveyor"},
+        200, "agent-conveyor", cap=300.0,
+    )
     # Kernel comparison (PERF.md plan item 2): the manual-DMA Pallas
     # paged-attention backend on the 8B int8 preset — the headline shape,
     # and the one whose head_dim (128) satisfies the kernel's Mosaic
@@ -675,6 +691,17 @@ def run_orchestrated() -> None:
         extra["agent_turn_p50_ttft_ms"] = ragent["value"]
         extra["agent_turn1_p50_ttft_ms"] = ae.get("turn1_p50_ttft_ms")
         extra["agent_prefix_hit_rate"] = ae.get("prefix_hit_rate")
+    if rconvey is not None:
+        ve = rconvey.get("extra", {})
+        extra["agent_conveyor_p50_ms"] = rconvey["value"]
+        extra["agent_conveyor_off_p50_ms"] = ve.get("off_p50_ms")
+        extra["agent_conveyor_overlap_ms_per_turn"] = ve.get(
+            "overlap_ms_per_turn"
+        )
+        extra["agent_conveyor_early_launches"] = ve.get("early_launches")
+        extra["agent_conveyor_outputs_identical"] = ve.get(
+            "outputs_identical"
+        )
     if rspec is not None:
         extra[f"spec{SPEC_K}_overhead_tok_s_chip"] = rspec["value"]
     if rdma is not None and headline is not rdma:
@@ -710,8 +737,8 @@ def run_orchestrated() -> None:
     # printed, so the verdict can never eat a result line.
     exit_if_perf_regression([
         r1, r8b, r8b4, r8bkv, r8b4kv, rsess, rsessmix, rsessasync,
-        rsessoff, rfleet, rchaos, rfgkv, ragent, rdma, rdmakv, rcold,
-        rcoldstart, rspec,
+        rsessoff, rfleet, rchaos, rfgkv, ragent, rconvey, rdma, rdmakv,
+        rcold, rcoldstart, rspec,
     ])
 
 
@@ -752,6 +779,12 @@ def run_single() -> None:
     # (decode_block x (pipeline_depth + 1) tokens are pre-booked).
     spec_k = int(os.environ.get("OPSAGENT_BENCH_SPEC", "0"))
     mode = os.environ.get("OPSAGENT_BENCH_MODE", "")
+    if mode == "agent-conveyor":
+        # Trains its own tiny checkpoint and builds its own engine (BPE
+        # tokenizer, trained weights) — intercept before the shared
+        # construction below.
+        run_agent_conveyor(platform, n_chips)
+        return
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "sessions-ffwd", "fleet-affinity",
                 "fleet-chaos", "fleet-global-kv", "cold-start"):
@@ -2363,6 +2396,213 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
         log(f"bench[agent]: first error: {errors[0]}")
     log_perf_table()
     stack.close()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_agent_conveyor(platform, n_chips) -> None:
+    """The conveyor tool-overlap A/B stage: can the agent loop hide tool
+    execution behind the decode of the constrained stream's tail?
+
+    Random weights cannot drive this (an untrained model never closes
+    the JSON fields the launch gate watches), so the stage first trains
+    the tiny BPE agent IN-PROCESS to memorization on the
+    count-namespaces episode (seconds on CPU: loss < 0.01 typically by
+    step ~50), serves the checkpoint, and runs the scripted episode
+    ``episodes`` times with conveyor launches ON then OFF against the
+    same engine. The replayed kubectl is wrapped with a fixed artificial
+    delay (identical in both phases) so the tool has a real execution
+    window for the conveyor to overlap with the post-action decode
+    (observation/final_answer fields). Decision numbers per phase: p50
+    episode wall (one tool-call turn + one final-answer turn — the unit
+    "ms/turn" is per scripted tool turn), overlap seconds banked, early
+    launch count, byte-identical transcripts across phases (the launch
+    is a prefix bet; correctness means it never changes WHAT the agent
+    says), and zero post-warmup compiles in both phases."""
+    import shutil
+    import tempfile
+
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        from train_tiny_agent import (
+            INSTRUCTION,
+            SYS_PROMPT,
+            train_checkpoint,
+        )
+    finally:
+        sys.path.remove(scripts_dir)
+
+    from opsagent_tpu import obs
+    from opsagent_tpu import tools as tools_pkg
+    from opsagent_tpu.agent.react import assistant_with_config
+    from opsagent_tpu.serving import api as serving_api
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.tools.replay import (
+        NAMESPACES_SCRIPT,
+        install_replay_kubectl,
+    )
+
+    episodes = int(os.environ.get("OPSAGENT_BENCH_AGENT_EPISODES", "6"))
+    train_steps = int(os.environ.get("OPSAGENT_BENCH_TRAIN_STEPS", "600"))
+    tool_delay_s = (
+        float(os.environ.get("OPSAGENT_BENCH_TOOL_DELAY_MS", "150")) / 1e3
+    )
+    work = tempfile.mkdtemp(prefix="opsagent-bench-conveyor-")
+
+    # -- train to memorization (the same recipe scripts/train_tiny_agent
+    # uses; the BPE tokenizer keeps prompts compact and exercises the
+    # HFTokenizer path real checkpoints use) ------------------------------
+    ckpt, tok_path, cfg, loss, train_s = train_checkpoint(
+        work, steps=train_steps
+    )
+    log(f"bench[agent-conveyor]: trained to loss {loss:.4f} "
+        f"in {train_s:.1f}s")
+
+    # -- serve the checkpoint; pace the replayed kubectl so the tool has
+    # an execution window the conveyor can hide --------------------------
+    install_replay_kubectl(NAMESPACES_SCRIPT, os.path.join(work, "bin"))
+    real_kubectl = tools_pkg.get_tools()["kubectl"]
+
+    def paced_kubectl(arg: str) -> str:
+        time.sleep(tool_delay_s)
+        return real_kubectl(arg)
+
+    tools_pkg.copilot_tools["kubectl"] = paced_kubectl
+
+    t0 = time.perf_counter()
+    eng = Engine(
+        EngineConfig(
+            model="tiny-test",
+            checkpoint=ckpt,
+            tokenizer=tok_path,
+            dtype=jnp.float32,
+            num_pages=512,
+            page_size=16,
+            max_pages_per_seq=64,
+            max_batch_size=2,
+            prefill_buckets=(128, 512, 1024),
+        ),
+        model_cfg=cfg,
+    )
+    init_s = time.perf_counter() - t0
+    # "sessions" warmup pre-specializes the ToolPrompt FSM tables and the
+    # forced-token fast-forward program: both phases must decode
+    # compile-free.
+    warmup_s = eng.warmup("sessions")
+    log(f"bench[agent-conveyor]: engine init {init_s:.1f}s "
+        f"warmup {warmup_s:.1f}s")
+
+    messages0 = [
+        {"role": "system", "content": SYS_PROMPT},
+        {"role": "user",
+         "content": f"Here are the instructions: {INSTRUCTION}"},
+    ]
+    conveyor_prev = os.environ.get("OPSAGENT_CONVEYOR")
+    phases: dict[str, dict] = {}
+    try:
+        for tag, on in (("on", True), ("off", False)):
+            os.environ["OPSAGENT_CONVEYOR"] = "1" if on else "0"
+            get_perf_stats().reset()
+            overlap0 = obs.TOOL_OVERLAP_SECONDS.value()
+            early0 = obs.TOOL_EARLY_LAUNCHES.value(tool="kubectl")
+            compiles0 = obs.POST_WARMUP_COMPILES.value()
+            stack = serving_api.ServingStack(eng)
+            serving_api.install_stack("bench-conveyor", stack)
+            walls: list[float] = []
+            transcripts: list[str] = []
+            errors: list[str] = []
+            try:
+                for _ in range(episodes):
+                    te = time.perf_counter()
+                    try:
+                        _answer, history = assistant_with_config(
+                            "tpu://bench-conveyor",
+                            [dict(m) for m in messages0],
+                            256, False, False, 4, "", "",
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(str(e))
+                        continue
+                    walls.append(time.perf_counter() - te)
+                    transcripts.append(json.dumps(
+                        [(m["role"], m["content"]) for m in history]
+                    ))
+            finally:
+                serving_api.uninstall_stack("bench-conveyor")
+                stack.close()
+            r = {
+                "p50_ms": (
+                    float(np.median(walls) * 1e3) if walls else 0.0
+                ),
+                "overlap_s": obs.TOOL_OVERLAP_SECONDS.value() - overlap0,
+                "early_launches": int(
+                    obs.TOOL_EARLY_LAUNCHES.value(tool="kubectl") - early0
+                ),
+                "post_warmup_compiles": int(
+                    obs.POST_WARMUP_COMPILES.value() - compiles0
+                ),
+                "walls": walls,
+                "transcripts": transcripts,
+                "errors": errors,
+            }
+            phases[tag] = r
+            log(f"bench[agent-conveyor/{tag}]: {len(walls)}/{episodes} "
+                f"episodes, p50 {r['p50_ms']:.0f} ms/turn; "
+                f"{r['early_launches']} early launches, "
+                f"{r['overlap_s'] * 1e3:.0f} ms overlapped; "
+                f"post-warmup compiles {r['post_warmup_compiles']}; "
+                f"errors={len(errors)}")
+    finally:
+        if conveyor_prev is None:
+            os.environ.pop("OPSAGENT_CONVEYOR", None)
+        else:
+            os.environ["OPSAGENT_CONVEYOR"] = conveyor_prev
+        tools_pkg.copilot_tools["kubectl"] = real_kubectl
+
+    a, b = phases["on"], phases["off"]
+    identical = (
+        a["transcripts"] == b["transcripts"]
+        and not a["errors"] and not b["errors"]
+    )
+    print(json.dumps({
+        "metric": f"agent_conveyor[tiny-agent,{platform}]",
+        "value": round(a["p50_ms"], 1),
+        "unit": "ms/turn",
+        "vs_baseline": None,
+        "extra": {
+            "episodes": episodes,
+            "train_loss": round(loss, 4),
+            "train_s": round(train_s, 1),
+            "tool_delay_ms": round(tool_delay_s * 1e3, 1),
+            "overlap_ms_per_turn": round(
+                a["overlap_s"] / max(1, len(a["walls"])) * 1e3, 1
+            ),
+            "overlap_s_total": round(a["overlap_s"], 4),
+            "early_launches": a["early_launches"],
+            "off_p50_ms": round(b["p50_ms"], 1),
+            "off_overlap_s_total": round(b["overlap_s"], 4),
+            "off_early_launches": b["early_launches"],
+            "p50_delta_ms": round(b["p50_ms"] - a["p50_ms"], 1),
+            "outputs_identical": identical,
+            "post_warmup_compiles_on": a["post_warmup_compiles"],
+            "post_warmup_compiles_off": b["post_warmup_compiles"],
+            "errors": len(a["errors"]) + len(b["errors"]),
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
+            "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    if a["errors"] or b["errors"]:
+        log(f"bench[agent-conveyor]: first error: "
+            f"{(a['errors'] or b['errors'])[0]}")
+    log_perf_table()
+    shutil.rmtree(work, ignore_errors=True)
     exit_if_slo_breach(slo_verdicts())
 
 

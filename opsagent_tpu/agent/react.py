@@ -36,6 +36,7 @@ from ..tools import ToolPrompt, get_tools, ToolError
 from ..utils.jsonrepair import extract_field
 from ..utils.logger import get_logger
 from ..utils.perf import get_perf_stats, trace_func
+from . import conveyor
 from .prompts import SUMMARIZE_PROMPT
 
 log = get_logger("agent")
@@ -156,7 +157,69 @@ def _react_loop(
                 model, max_tokens, sendable, response_format=response_format
             )
 
-    reply = call(chat_history, response_format=toolprompt_rf)
+    # Conveyor tool overlap (agent/conveyor.py): when the turn decodes
+    # under the ToolPrompt constraint in-process, stream it and launch the
+    # tool the moment its argument fields close — the JSON tail decodes
+    # while the subprocess already runs. The launch is validated against
+    # the full parse below; any divergence cancels it and re-runs the
+    # classic blocking path, so transcripts are byte-identical on vs off.
+    use_conveyor = toolprompt_rf is not None and conveyor.enabled()
+
+    def call_turn(
+        msgs: list[dict[str, Any]],
+    ) -> tuple[str, "conveyor.TurnConveyor | None"]:
+        if not use_conveyor:
+            return call(msgs, response_format=toolprompt_rf), None
+        sendable = constrict_messages(msgs, model, max_tokens) if count_tokens else msgs
+        obs.AGENT_ITERATIONS.inc()
+        with ps.timer("agent.llm_turn"), obs.span("llm_turn"):
+            turn = conveyor.TurnConveyor(
+                tools, model=model, park_messages=sendable,
+                schema=toolprompt_rf["json_schema"]["schema"],
+            )
+            try:
+                streamed = conveyor.stream_constrained_turn(
+                    model, max_tokens, sendable, toolprompt_rf,
+                    turn.on_delta,
+                )
+            except BaseException:
+                # The engine call failed; a speculative launch must not
+                # outlive the turn it bet on.
+                turn.abort()
+                raise
+            turn.finish_stream()
+        return streamed, turn
+
+    def consume_launch(
+        turn: "conveyor.TurnConveyor", name: str
+    ) -> str | None:
+        """Collect an early launch's observation; None = launch errored
+        (the caller falls back to the classic blocking relaunch)."""
+        launch = turn.launch
+        assert launch is not None
+        t_wait = time.perf_counter()
+        try:
+            # The tool_exec span covers only the RESIDUAL wait — the
+            # overlapped part of the tool's runtime was decode time, not
+            # blocked time, and the goodput ledger sees it the same way.
+            with ps.timer(f"agent.tool.{name}"), \
+                    obs.span("tool_exec", tool=name):
+                observation = launch.result()
+        except Exception as e:  # noqa: BLE001 - incl. injected faults
+            obs.TOOL_CALLS.inc(tool=name, outcome="error")
+            turn.record_exit("error", str(e))
+            if verbose:
+                log.info("conveyor launch failed (%s); falling back", e)
+            return None
+        residual = time.perf_counter() - t_wait
+        obs.attribution.record_goodput(residual, "tool_blocked")
+        overlap = turn.overlap_s()
+        obs.TOOL_OVERLAP_SECONDS.inc(overlap)
+        obs.TOOL_CALLS.inc(tool=name, outcome="ok")
+        turn.record_exit("ok", overlap_s=overlap)
+        return observation
+
+    reply, turn = call_turn(chat_history)
     chat_history.append({"role": "assistant", "content": reply})
     if verbose:
         log.info("initial reply: %s", reply[:500])
@@ -165,6 +228,8 @@ def _react_loop(
         prompt = ToolPrompt.from_json(reply)
     except ValueError:
         # Unparseable first reply: treat the raw text as the final answer.
+        if turn is not None:
+            turn.abort()
         return reply, chat_history
 
     iterations = 0
@@ -172,17 +237,43 @@ def _react_loop(
         iterations += 1
         if iterations > max_iterations:
             log.warning("iteration cap %d reached", max_iterations)
+            if turn is not None:
+                turn.abort()
             return reply, chat_history
 
         if prompt.final_answer and not is_template_value(prompt.final_answer):
             if prompt.observation.strip():
+                if turn is not None:
+                    turn.abort()
                 return reply, chat_history
             if verbose:
                 log.info("final_answer offered without observation; continuing")
 
         name = prompt.action.name.strip()
         tool_input = prompt.action.input
-        if name and name in tools:
+        launch = turn.launch if turn is not None else None
+        observation: str | None = None
+        if name and name in tools and launch is not None:
+            if launch.matches(name, tool_input):
+                # The launched prefix IS the parsed call: collect the
+                # overlapped execution (None = launch errored; the
+                # classic block below relaunches inline).
+                observation = consume_launch(turn, name)
+            else:
+                # Launched prefix ≠ final parse (the stream-side extract
+                # and the repair-ladder parse disagreed): cancel the bet,
+                # run the classic path. The flight ring records both
+                # pairs — the cancelled early launch and the relaunch.
+                turn.abort()
+                launch = None
+        elif launch is not None:
+            # The parsed reply doesn't dispatch a registered tool at all
+            # (final answer / unknown tool): abandon the speculation.
+            turn.abort()
+            launch = None
+        if observation is not None:
+            pass  # conveyor launch delivered the observation
+        elif name and name in tools:
             if verbose:
                 log.info("tool %s input=%r", name, tool_input[:200])
             # Tool-time parking (hierarchical KV tier): the subprocess the
@@ -190,9 +281,12 @@ def _react_loop(
             # in-tree engine can copy the session's KV pages to host RAM
             # and free the HBM for queued prompts — the next turn restores
             # them instead of re-prefilling. No-op for remote providers
-            # and engines without the offload tier.
+            # and engines without the offload tier. A conveyor turn
+            # already parked at LAUNCH time — don't double-count.
             parked_tokens = 0
-            if (model or "").startswith("tpu://"):
+            if (model or "").startswith("tpu://") and not (
+                turn is not None and turn.launch is not None
+            ):
                 try:
                     from ..serving.api import park_session
 
@@ -267,7 +361,7 @@ def _react_loop(
         prompt.observation = constrict_prompt(observation, OBSERVATION_TOKEN_LIMIT)
         chat_history.append({"role": "user", "content": prompt.to_json()})
 
-        reply = call(chat_history, response_format=toolprompt_rf)
+        reply, turn = call_turn(chat_history)
         chat_history.append({"role": "assistant", "content": reply})
         if verbose:
             log.info("iteration %d reply: %s", iterations, reply[:500])
@@ -277,6 +371,8 @@ def _react_loop(
         except ValueError:
             # Mid-loop unparseable reply: one summarization turn, then a
             # best-effort final_answer extraction.
+            if turn is not None:
+                turn.abort()
             chat_history.append({"role": "user", "content": SUMMARIZE_PROMPT})
             reply = call(chat_history)
             chat_history.append({"role": "assistant", "content": reply})

@@ -442,6 +442,23 @@ TOOL_CALLS = _reg.counter(
     "Agent tool invocations by tool and outcome",
     labelnames=("tool", "outcome"),
 )
+TOOL_OVERLAP_SECONDS = _reg.counter(
+    "opsagent_tool_overlap_seconds_total",
+    "Seconds of tool execution hidden behind decode by conveyor "
+    "launches (launch to min(tool end, stream end))",
+)
+TOOL_EARLY_LAUNCHES = _reg.counter(
+    "opsagent_tool_early_launches_total",
+    "Conveyor tool launches fired mid-decode at readiness-close",
+    labelnames=("tool",),
+)
+TOOL_LAUNCH_LEAD_SECONDS = _reg.histogram(
+    "opsagent_tool_launch_lead_seconds",
+    "Lead time a conveyor launch gained over the classic path "
+    "(launch to stream end)",
+    labelnames=("tool",),
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+)
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

@@ -81,14 +81,18 @@ def _tool_windows_from_events(
             open_enters.append(e)
         elif e.get("phase") == "exit" and open_enters:
             ent = open_enters.pop()
-            out.append((
-                "tool_blocked", ent["ts"], e["ts"],
-                {
-                    "tool": e.get("tool"),
-                    "outcome": e.get("outcome"),
-                    "source": "flight",
-                },
-            ))
+            attrs = {
+                "tool": e.get("tool"),
+                "outcome": e.get("outcome"),
+                "source": "flight",
+            }
+            # Conveyor launches mark both halves of the pair; the enter
+            # additionally carries how far into decode the launch fired.
+            if ent.get("conveyor") or e.get("conveyor"):
+                attrs["conveyor"] = True
+            if ent.get("launch_offset_ms") is not None:
+                attrs["launch_offset_ms"] = ent["launch_offset_ms"]
+            out.append(("tool_blocked", ent["ts"], e["ts"], attrs))
     for ent in open_enters:
         out.append((
             "tool_blocked", ent["ts"], last_ts,
@@ -220,11 +224,36 @@ def assemble(request_id: str) -> dict[str, Any] | None:
                 intervals.append(("prefill", a, ft, {"seq_id": sid}))
                 end = fin.get(sid, t1)
                 intervals.append(("decode_active", ft, end, {"seq_id": sid}))
-    intervals.extend(
+    tool_ivs = [
         iv for iv in _tool_windows_from_events(events)
         if t0 <= iv[1] <= t1 or t0 <= iv[2] <= t1
-    )
+    ]
+    intervals.extend(tool_ivs)
     phases = _sweep(intervals, t0, t1)
+
+    # Conveyor overlap: the stretch of each early-launched tool window
+    # that ran concurrently with decode. The sweep hides it by design
+    # (its phases partition wall clock, and concurrent time IS decode),
+    # so it is surfaced as separate windows rather than a phase.
+    decode_ivs = [(a, b) for ph, a, b, _ in intervals
+                  if ph == "decode_active"]
+    overlap_windows: list[dict[str, Any]] = []
+    for _ph, a, b, attrs in tool_ivs:
+        if not attrs.get("conveyor"):
+            continue
+        for da, db in decode_ivs:
+            oa, ob = max(a, da), min(b, db)
+            if ob - oa > 1e-6:
+                overlap_windows.append({
+                    "tool": attrs.get("tool"),
+                    "start_ms": round((oa - t0) * 1e3, 3),
+                    "end_ms": round((ob - t0) * 1e3, 3),
+                    "duration_ms": round((ob - oa) * 1e3, 3),
+                })
+    overlap_windows.sort(key=lambda w: w["start_ms"])
+    tool_overlap_ms = round(
+        sum(w["duration_ms"] for w in overlap_windows), 3
+    )
 
     total_ms = max(1e-9, (t1 - t0) * 1e3)
     by_phase: dict[str, float] = {}
@@ -253,6 +282,8 @@ def assemble(request_id: str) -> dict[str, Any] | None:
         "seq_ids": sorted(seq_ids),
         "goodput": goodput,
         "phases": phases,
+        "tool_overlap_ms": tool_overlap_ms,
+        "overlap_windows": overlap_windows,
         "events": ev_out,
     }
 
@@ -288,18 +319,39 @@ def render_gantt(timeline: dict[str, Any], width: int = 64) -> str:
             )
             + f"  (coverage {100.0 * g.get('coverage', 0.0):.1f}%)"
         )
+    overlaps = timeline.get("overlap_windows") or []
     name_w = max(
-        [len(p.get("phase", "")) for p in timeline.get("phases", [])] + [5]
+        [len(p.get("phase", "")) for p in timeline.get("phases", [])]
+        + ([len("tool_overlap")] if overlaps else [])
+        + [5]
     )
-    for seg in timeline.get("phases", []):
-        a = int(round(seg["start_ms"] / total * width))
-        b = int(round(seg["end_ms"] / total * width))
+
+    def _row(name: str, start_ms: float, end_ms: float, dur_ms: float,
+             tag: str) -> str:
+        a = int(round(start_ms / total * width))
+        b = int(round(end_ms / total * width))
         b = min(width, max(b, a + 1))
         bar = _PAD * a + _BAR * (b - a) + _PAD * (width - b)
+        return f"{name:<{name_w}s} |{bar}| {dur_ms:8.1f} ms{tag}"
+
+    for seg in timeline.get("phases", []):
         attrs = seg.get("attrs") or {}
         tag = f" tool={attrs['tool']}" if attrs.get("tool") else ""
+        lines.append(_row(
+            seg["phase"], seg["start_ms"], seg["end_ms"],
+            seg["duration_ms"], tag,
+        ))
+    # Conveyor windows: tool run time hidden under decode, drawn as extra
+    # rows so the tool bar visibly overlaps the decode span above.
+    for w in overlaps:
+        tag = f" tool={w['tool']}" if w.get("tool") else ""
+        lines.append(_row(
+            "tool_overlap", w["start_ms"], w["end_ms"],
+            w["duration_ms"], tag,
+        ))
+    if overlaps:
         lines.append(
-            f"{seg['phase']:<{name_w}s} |{bar}| "
-            f"{seg['duration_ms']:8.1f} ms{tag}"
+            f"tool overlap hidden behind decode: "
+            f"{timeline.get('tool_overlap_ms', 0.0):.1f} ms"
         )
     return "\n".join(lines)

@@ -108,3 +108,37 @@ def get_tools() -> dict[str, Tool]:
     if not copilot_tools:
         copilot_tools.update(default_registry())
     return copilot_tools
+
+
+# -- conveyor launch readiness ---------------------------------------------
+#
+# Each tool module declares LAUNCH_FIELDS: the logical argument names that
+# suffice to START its subprocess (conveyor partial execution,
+# agent/conveyor.py). The ReAct wire format carries every tool's arguments
+# in the single ``action.input`` string, so each logical field maps onto
+# the ``input`` wire field here; a richer wire format (native tool_calls)
+# would map them onto distinct JSON fields instead.
+
+LAUNCH_READY: dict[str, tuple[str, ...]] = {
+    "kubectl": ("command",),
+    "python": ("script",),
+    "trivy": ("image",),
+    "jq": ("data", "expr"),
+}
+
+_WIRE_FIELD = "input"
+
+
+def launch_ready_fields(name: str) -> tuple[str, ...]:
+    """Logical argument fields that must have closed before ``name`` may
+    launch. Unknown-but-registered tools conservatively require their
+    full input."""
+    return LAUNCH_READY.get(name, (_WIRE_FIELD,))
+
+
+def wire_fields_for(name: str) -> frozenset[str]:
+    """The JSON wire fields carrying the launch-ready arguments — on the
+    ReAct single-input wire every logical field rides in ``input``."""
+    return frozenset(
+        _WIRE_FIELD for _ in launch_ready_fields(name)
+    ) or frozenset((_WIRE_FIELD,))

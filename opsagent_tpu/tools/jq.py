@@ -15,8 +15,13 @@ import re
 import subprocess
 from typing import Any
 
-from . import ToolError
+from . import ToolError, proc
 from ..utils.perf import get_perf_stats
+
+# Conveyor launch readiness (agent/conveyor.py): jq needs BOTH halves of
+# its piped input — the JSON document and the expression — before a
+# launch makes sense (the single ReAct ``input`` string carries both).
+LAUNCH_FIELDS = ("data", "expr")
 
 
 def _split_input(s: str) -> tuple[str, str]:
@@ -93,16 +98,12 @@ def jq(input_str: str, timeout: float = 30.0) -> str:
     ps.record_metric("tool.jq.complexity", _complexity(expr), "ops")
     with ps.timer("tool.jq"):
         try:
-            proc = subprocess.run(
-                ["jq", expr],
-                input=json.dumps(parsed),
-                capture_output=True,
-                text=True,
-                timeout=timeout,
+            res = proc.run(
+                ["jq", expr], input_text=json.dumps(parsed), timeout=timeout
             )
-            if proc.returncode != 0:
-                raise ToolError(proc.stderr.strip() or "jq failed")
-            return proc.stdout.strip()
+            if res.returncode != 0:
+                raise ToolError(res.stderr.strip() or "jq failed")
+            return res.stdout.strip()
         except FileNotFoundError:
             result = _eval_path(parsed, expr)
             if isinstance(result, list):

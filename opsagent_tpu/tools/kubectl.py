@@ -11,8 +11,12 @@ from __future__ import annotations
 import re
 import subprocess
 
-from . import ToolError
+from . import ToolError, proc
 from ..utils.perf import get_perf_stats
+
+# Conveyor launch readiness (agent/conveyor.py): the shell line is the
+# only argument kubectl needs to start.
+LAUNCH_FIELDS = ("command",)
 
 _VERBS = ("get", "describe", "logs", "exec", "apply", "delete", "top", "create", "patch")
 
@@ -45,19 +49,14 @@ def kubectl(command: str, timeout: float = 90.0) -> str:
     ps.record_metric(f"tool.kubectl.{_classify(cmd)}", 1, "calls")
     with ps.timer("tool.kubectl"):
         try:
-            proc = subprocess.run(
-                ["bash", "-c", cmd],
-                capture_output=True,
-                text=True,
-                timeout=timeout,
-            )
+            res = proc.run(["bash", "-c", cmd], timeout=timeout)
         except FileNotFoundError as e:
             raise ToolError(f"kubectl not available: {e}") from e
         except subprocess.TimeoutExpired as e:
             raise ToolError(f"kubectl timed out after {timeout}s: {cmd}") from e
-    out = filter_noise(proc.stdout)
-    err = filter_noise(proc.stderr)
-    if proc.returncode != 0:
-        raise ToolError(err or out or f"kubectl exited with {proc.returncode}")
+    out = filter_noise(res.stdout)
+    err = filter_noise(res.stderr)
+    if res.returncode != 0:
+        raise ToolError(err or out or f"kubectl exited with {res.returncode}")
     result = out or err
     return result if result else "(no output)"

@@ -14,9 +14,13 @@ import os
 import subprocess
 import sys
 
-from . import ToolError
+from . import ToolError, proc
 
 _VENV_PY = os.path.expanduser("~/k8s/python-cli/k8s-env/bin/python3")
+
+# Conveyor launch readiness (agent/conveyor.py): the script body is the
+# only argument needed to start the interpreter.
+LAUNCH_FIELDS = ("script",)
 
 
 def interpreter() -> str:
@@ -25,16 +29,14 @@ def interpreter() -> str:
 
 def python_repl(script: str, timeout: float = 120.0) -> str:
     try:
-        proc = subprocess.run(
+        res = proc.run(
             [interpreter(), "-c", script],
-            capture_output=True,
-            text=True,
             timeout=timeout,
             cwd=os.path.expanduser("~"),
         )
     except subprocess.TimeoutExpired as e:
         raise ToolError(f"python script timed out after {timeout}s") from e
-    if proc.returncode != 0:
-        raise ToolError(proc.stderr.strip() or f"python exited with {proc.returncode}")
-    out = proc.stdout.strip()
+    if res.returncode != 0:
+        raise ToolError(res.stderr.strip() or f"python exited with {res.returncode}")
+    out = res.stdout.strip()
     return out if out else "(no output)"

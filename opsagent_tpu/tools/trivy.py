@@ -9,7 +9,11 @@ from __future__ import annotations
 
 import subprocess
 
-from . import ToolError
+from . import ToolError, proc
+
+# Conveyor launch readiness (agent/conveyor.py): the image name is the
+# only argument the scanner needs to start.
+LAUNCH_FIELDS = ("image",)
 
 
 def trivy(image: str, timeout: float = 300.0) -> str:
@@ -19,16 +23,13 @@ def trivy(image: str, timeout: float = 300.0) -> str:
     if not img:
         raise ToolError("no image name given to trivy")
     try:
-        proc = subprocess.run(
-            ["trivy", "image", img, "--scanners", "vuln"],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
+        res = proc.run(
+            ["trivy", "image", img, "--scanners", "vuln"], timeout=timeout
         )
     except FileNotFoundError as e:
         raise ToolError(f"trivy not available: {e}") from e
     except subprocess.TimeoutExpired as e:
         raise ToolError(f"trivy timed out after {timeout}s") from e
-    if proc.returncode != 0:
-        raise ToolError(proc.stderr.strip() or f"trivy exited with {proc.returncode}")
-    return proc.stdout.strip() or "(no output)"
+    if res.returncode != 0:
+        raise ToolError(res.stderr.strip() or f"trivy exited with {res.returncode}")
+    return res.stdout.strip() or "(no output)"

@@ -261,6 +261,19 @@ def terminal_summary(paths: list[str]) -> int:
               f"{best_a['value']:.0f} ms "
               f"({'<' if best_a['value'] < 500 else '>='} 500 ms target); "
               f"prefix hit rate {hr}")
+    # Conveyor A/B runs on CPU too — match across all rows, not just tpu.
+    convey = [d for d in rows if d["metric"].startswith("agent_conveyor")]
+    if convey:
+        d = convey[-1]
+        e = d.get("extra", {})
+        print(
+            f"conveyor A/B: agent turn p50 {d['value']:.0f} ms (on) vs "
+            f"{e.get('off_p50_ms', 0):.0f} ms (off); "
+            f"{e.get('overlap_ms_per_turn', 0)} ms/turn tool time hidden "
+            f"behind decode ({e.get('early_launches', 0)} early "
+            f"launches); transcripts identical: "
+            f"{e.get('outputs_identical')}"
+        )
     # SLO verdicts folded into the lines (bench.py extra.slo), newest last.
     slo_rows = [d for d in rows if d.get("extra", {}).get("slo")]
     if slo_rows:

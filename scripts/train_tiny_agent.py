@@ -303,6 +303,69 @@ def build_dataset(tok, tasks=None):
     return rows
 
 
+def train_checkpoint(out_dir, steps=600, target_loss=0.01, lr=3e-3,
+                     tasks=None):
+    """Programmatic train-to-memorization for callers that need a tiny
+    agent checkpoint in-process (the agent-conveyor bench stage, the
+    conveyor e2e test): the same tiny-test + BPE recipe as ``main()``,
+    minus the CLI/serve scaffolding. Falls back to the byte tokenizer
+    when the ``tokenizers`` package is absent. Returns
+    ``(ckpt_path, tok_path, model_cfg, final_loss, train_s)`` with
+    ``tok_path == ""`` on the byte-tokenizer fallback."""
+    import dataclasses
+
+    from opsagent_tpu.models.config import get_config_preset
+    from opsagent_tpu.models.loader import save_checkpoint
+    from opsagent_tpu.parallel.mesh import make_mesh
+    from opsagent_tpu.training import (
+        TrainConfig,
+        init_train_state,
+        make_train_step,
+    )
+
+    tasks = tasks or TASKS_SINGLE
+    cfg = get_config_preset("tiny-test")
+    try:
+        from opsagent_tpu.serving.tokenizer import load_tokenizer
+
+        tok_path = train_bpe_tokenizer(out_dir, tasks=tasks)
+        tok = load_tokenizer(tok_path)
+        cfg = dataclasses.replace(cfg, vocab_size=tok.vocab_size)
+    except ImportError:
+        from opsagent_tpu.serving.tokenizer import ByteTokenizer
+
+        tok_path = ""
+        tok = ByteTokenizer(vocab_size=cfg.vocab_size)
+    rows = build_dataset(tok, tasks)
+    S = 8 * ((max(len(ids) for ids, _ in rows) + 7) // 8)
+    tokens = np.full((len(rows), S), tok.pad_id, np.int32)
+    mask = np.zeros((len(rows), S), np.float32)
+    for i, (ids, m) in enumerate(rows):
+        tokens[i, :len(ids)] = ids
+        mask[i, :len(m)] = m
+    mesh = make_mesh(tp=1, dp=1, sp=1, devices=jax.devices()[:1])
+    tc = TrainConfig(learning_rate=lr, weight_decay=0.0, remat=False)
+    params, opt_state = init_train_state(
+        cfg, tc, mesh, jax.random.PRNGKey(0), dtype=jnp.float32
+    )
+    train_step = make_train_step(cfg, tc, mesh, dtype=jnp.float32)
+    tokens_j, mask_j = jnp.asarray(tokens), jnp.asarray(mask)
+    t0 = time.perf_counter()
+    loss = float("inf")
+    for i in range(steps):
+        params, opt_state, tmetrics = train_step(
+            params, opt_state, tokens_j, mask_j
+        )
+        if i % 50 == 0 or i == steps - 1:
+            loss = float(tmetrics["loss"])
+            if loss < target_loss:
+                break
+    train_s = time.perf_counter() - t0
+    ckpt = os.path.join(out_dir, "model.safetensors")
+    save_checkpoint(ckpt, params)
+    return ckpt, tok_path, cfg, loss, train_s
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=800)

@@ -141,6 +141,9 @@ SLOW_TESTS = (
     "test_bench_harness.py::test_tiny_budget_goes_straight_to_fallback",
     "test_bench_harness.py::test_orchestrated_cpu_ends_with_headline_json",
     "test_bench_harness.py::test_agent_mode_reports_per_turn_ttft_and_hit_rate",
+    "test_bench_harness.py::test_agent_conveyor_mode_reports_ab_numbers",
+    "test_conveyor.py::test_park_at_launch_frees_pages_for_readmission",
+    "test_conveyor.py::test_trained_agent_e2e_gantt_shows_overlap",
     "test_trained_agent.py::test_train_serve_agent_roundtrip",
     "test_pipeline.py::test_pp2_",
     "test_pipeline.py::test_pp_remat_matches",

@@ -291,6 +291,45 @@ def test_sessions_ffwd_mode_reports_ab_numbers():
     assert e["outputs_identical"] is True
 
 
+def test_agent_conveyor_mode_reports_ab_numbers():
+    """OPSAGENT_BENCH_MODE=agent-conveyor (the CPU-capable conveyor
+    tool-overlap A/B stage) must train the tiny agent to memorization,
+    run the scripted episode with conveyor launches ON then OFF against
+    one warmed engine, and emit both phases in ONE JSON line. The
+    on-phase must fire an early launch per tool turn and bank real
+    overlap seconds; the off-phase must fire none; transcripts must be
+    byte-identical across phases and neither may compile post-warmup."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "agent-conveyor",
+        "OPSAGENT_BENCH_AGENT_EPISODES": "3",
+    }, timeout=540)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"].startswith("agent_conveyor[")
+    assert parsed["unit"] == "ms/turn"
+    assert parsed["value"] > 0
+    e = parsed["extra"]
+    assert e["errors"] == 0
+    assert e["train_loss"] < 0.05
+    # The on-phase launched the tool mid-decode on every scripted turn
+    # and hid real tool time behind the stream's tail.
+    assert e["early_launches"] >= 3
+    assert e["overlap_s_total"] > 0
+    assert e["overlap_ms_per_turn"] > 0
+    # The off-phase is the classic blocking path.
+    assert e["off_early_launches"] == 0
+    assert e["off_overlap_s_total"] == 0
+    assert e["off_p50_ms"] > 0
+    # The launch is a prefix bet: it may move WHEN the tool runs, never
+    # what the agent says.
+    assert e["outputs_identical"] is True
+    # Warmup covered both phases (FSM tables + ffwd programs).
+    assert e["post_warmup_compiles_on"] == 0
+    assert e["post_warmup_compiles_off"] == 0
+
+
 def test_fleet_affinity_mode_reports_ab_numbers():
     """OPSAGENT_BENCH_MODE=fleet-affinity (the tier-1-safe fast-lane form
     of the fleet A/B stage: CPU, tiny model, 2 in-process replicas behind

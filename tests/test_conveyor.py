@@ -1,0 +1,748 @@
+"""Conveyor tool overlap tests (agent/conveyor.py + the react rewire).
+
+Covers the full ladder: the split-anywhere streaming JSON parser, the
+launch-readiness registry vs each tool module's declaration, the shared
+subprocess helper's group-kill discipline, the async ToolLaunch executor
+(incl. the tool.exec/tool.timeout fault points), TurnConveyor's
+launch-at-readiness + flight accounting, the ReAct-loop integration
+(transcript byte-equality on vs off, chaos fallback, mismatch-cancel),
+park-at-launch page accounting against a real offload-tier engine, the
+timeline's decode/tool overlap windows, and the trained-agent e2e whose
+gantt must show the tool window hidden behind decode.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from opsagent_tpu import obs
+from opsagent_tpu import tools as tools_pkg
+from opsagent_tpu.agent import conveyor, react
+from opsagent_tpu.agent.conveyor import (
+    StreamParser,
+    ToolLaunch,
+    TurnConveyor,
+    _call_path,
+)
+from opsagent_tpu.serving import faults
+from opsagent_tpu.serving.constrained import TOOLPROMPT_SCHEMA
+from opsagent_tpu.tools import (
+    LAUNCH_READY,
+    ToolError,
+    launch_ready_fields,
+    proc,
+    wire_fields_for,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tp_json(name="kubectl", tool_input="kubectl get ns",
+            observation="", final=""):
+    """A wire-order ToolPrompt reply like the constrained decode emits."""
+    return json.dumps({
+        "question": "how many namespaces?",
+        "thought": "count them",
+        "action": {"name": name, "input": tool_input},
+        "observation": observation,
+        "final_answer": final,
+    })
+
+
+def tool_events(events):
+    return [e for e in events if e.get("kind") == "tool_exec"]
+
+
+# -- streaming parser --------------------------------------------------------
+
+
+def test_call_path_of_toolprompt_schema():
+    assert _call_path(TOOLPROMPT_SCHEMA) == ("action",)
+    assert _call_path(None) == ("action",)
+    assert _call_path({"properties": {"invoke": {
+        "type": "object", "properties": {"name": {}, "input": {}},
+    }}}) == ("invoke",)
+
+
+def test_parser_event_order_char_by_char():
+    p = StreamParser(TOOLPROMPT_SCHEMA)
+    events = []
+    for ch in tp_json():
+        events.extend(p.feed(ch))
+    kinds = [(e.kind, e.field) for e in events]
+    # Wire order: question, thought, then the action object (name closes
+    # first, input closes second, then the object itself), then the tail.
+    assert kinds == [
+        ("field_closed", "question"),
+        ("field_closed", "thought"),
+        ("tool_name_closed", "name"),
+        ("arg_closed", "input"),
+        ("field_closed", ""),          # the action OBJECT closed
+        ("field_closed", "observation"),
+        ("field_closed", "final_answer"),
+        ("call_closed", ""),
+    ]
+    by_kind = {e.kind: e for e in events}
+    assert by_kind["tool_name_closed"].value == "kubectl"
+    assert by_kind["arg_closed"].value == "kubectl get ns"
+    assert by_kind["arg_closed"].path == ("action", "input")
+
+
+def test_parser_chunking_invariant():
+    """Any split of the stream yields the same events (a token's
+    detokenization can split escapes and keys arbitrarily)."""
+    text = tp_json(tool_input='get pods -o jsonpath="{.items}" \\ tail')
+    whole = StreamParser(TOOLPROMPT_SCHEMA).feed(text)
+    for n in (1, 2, 3, 7, 64):
+        p = StreamParser(TOOLPROMPT_SCHEMA)
+        chunked = []
+        for i in range(0, len(text), n):
+            chunked.extend(p.feed(text[i:i + n]))
+        assert [(e.kind, e.field, e.value) for e in chunked] == \
+            [(e.kind, e.field, e.value) for e in whole], f"chunk={n}"
+    args = [e.value for e in whole if e.kind == "arg_closed"]
+    assert args == ['get pods -o jsonpath="{.items}" \\ tail']
+
+
+def test_parser_escaped_quote_split_across_deltas():
+    p = StreamParser(TOOLPROMPT_SCHEMA)
+    text = tp_json(tool_input='echo "hi"')  # wire form carries \" escapes
+    cut = text.index('\\"') + 1  # split BETWEEN backslash and quote
+    events = p.feed(text[:cut]) + p.feed(text[cut:])
+    args = [e.value for e in events if e.kind == "arg_closed"]
+    assert args == ['echo "hi"']
+
+
+def test_parser_non_string_scalars_and_nesting():
+    p = StreamParser({"properties": {"call": {
+        "type": "object", "properties": {"name": {}},
+    }}})
+    events = p.feed(
+        '{"n": 42, "ok": true, "call": {"name": "jq", "depth": 3},'
+        ' "arr": [1, 2]}'
+    )
+    vals = {(e.kind, e.field): e.value for e in events}
+    assert vals[("field_closed", "n")] == 42
+    assert vals[("field_closed", "ok")] is True
+    assert vals[("tool_name_closed", "name")] == "jq"
+    assert vals[("arg_closed", "depth")] == 3
+    assert events[-1].kind == "call_closed"
+
+
+def test_parser_ignores_bytes_after_root_close():
+    p = StreamParser(TOOLPROMPT_SCHEMA)
+    events = p.feed(tp_json())
+    assert events[-1].kind == "call_closed"
+    assert p.feed('{"question": "again"}') == []
+
+
+# -- launch-readiness registry ----------------------------------------------
+
+
+def test_launch_ready_matches_tool_module_declarations():
+    """The central registry and each tool module's own LAUNCH_FIELDS
+    must agree — a drifted declaration would launch on the wrong field."""
+    from opsagent_tpu.tools import jq, kubectl, python_tool, trivy
+
+    mods = {
+        "kubectl": kubectl, "python": python_tool,
+        "trivy": trivy, "jq": jq,
+    }
+    for name, mod in mods.items():
+        assert LAUNCH_READY[name] == mod.LAUNCH_FIELDS, name
+        assert launch_ready_fields(name) == LAUNCH_READY[name]
+    # The agent wire format carries ONE "input" string per call, so every
+    # tool is stream-launchable the moment that field closes.
+    for name in list(LAUNCH_READY) + ["unknown-tool"]:
+        assert wire_fields_for(name) == frozenset({"input"})
+    assert launch_ready_fields("unknown-tool") == ("input",)
+
+
+# -- shared subprocess helper ------------------------------------------------
+
+
+def test_proc_run_matches_subprocess_contract():
+    r = proc.run([sys.executable, "-c", "print('out'); "
+                  "import sys; print('err', file=sys.stderr)"])
+    assert r.returncode == 0
+    assert r.stdout.strip() == "out"
+    assert r.stderr.strip() == "err"
+
+
+def test_proc_run_pipes_input_text():
+    r = proc.run([sys.executable, "-c",
+                  "import sys; print(sys.stdin.read().upper())"],
+                 input_text="hello")
+    assert r.stdout.strip() == "HELLO"
+
+
+def test_proc_timeout_kills_whole_group():
+    """A timed-out child's DESCENDANTS die too (the old subprocess.run
+    path leaked `bash -c` grandchildren past the timeout)."""
+    t0 = time.perf_counter()
+    p = proc.ToolProcess(
+        ["bash", "-c", "sleep 30 & echo started; wait"], timeout=0.3,
+    )
+    with pytest.raises(subprocess.TimeoutExpired):
+        p.result()
+    assert time.perf_counter() - t0 < 10.0
+    assert p.timed_out
+    # The group (bash + its backgrounded sleep) is gone.
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        try:
+            os.killpg(p.proc.pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("process group survived the timeout kill")
+
+
+def test_proc_cancel_scope_registers_spawns():
+    procs = []
+    with proc.cancel_scope(procs):
+        p = proc.ToolProcess(["sleep", "30"])
+    assert procs == [p]
+    p.cancel()
+    assert p.wait(5.0)
+    assert p.cancelled
+
+
+# -- async ToolLaunch executor ----------------------------------------------
+
+
+def test_tool_launch_result_and_matches():
+    launch = ToolLaunch("kubectl", "get ns", lambda s: f"ran:{s}")
+    assert launch.result() == "ran:get ns"
+    assert launch.done() and launch.t_done is not None
+    assert launch.matches("kubectl", "get ns")
+    assert not launch.matches("kubectl", "get pods")
+    assert not launch.matches("jq", "get ns")
+
+
+def test_tool_launch_delivers_errors():
+    def boom(s):
+        raise ToolError("kubectl not reachable")
+
+    launch = ToolLaunch("kubectl", "get ns", boom)
+    launch.wait(5.0)
+    assert isinstance(launch.error(), ToolError)
+    with pytest.raises(ToolError, match="not reachable"):
+        launch.result()
+
+
+def test_tool_launch_fault_points_fire_in_worker():
+    """tool.exec / tool.timeout inject into the ASYNC executor — the
+    same chaos surface the classic blocking path has."""
+    faults.configure("tool.exec@1")
+    try:
+        launch = ToolLaunch("kubectl", "get ns", lambda s: "ok")
+        with pytest.raises(ToolError, match="injected tool subprocess"):
+            launch.result()
+    finally:
+        faults.reset()
+    faults.configure("tool.timeout@1")
+    try:
+        launch = ToolLaunch("kubectl", "get ns", lambda s: "ok")
+        with pytest.raises(TimeoutError):
+            launch.result()
+    finally:
+        faults.reset()
+
+
+def test_tool_launch_cancel_reaps_subprocess():
+    """cancel() group-kills a subprocess the worker spawned via
+    tools/proc.py even though the canceller never held its handle."""
+    def slow_tool(s):
+        return proc.run(["sleep", "30"]).stdout
+
+    launch = ToolLaunch("python", "irrelevant", slow_tool)
+    deadline = time.monotonic() + 3.0
+    while not launch._procs and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert launch._procs, "worker never spawned its subprocess"
+    launch.cancel()
+    assert launch.wait(10.0), "cancelled worker did not unwind"
+    assert launch.cancelled
+
+
+# -- TurnConveyor ------------------------------------------------------------
+
+
+def test_turn_conveyor_launches_before_call_closes():
+    ran = []
+
+    def kubectl(s):
+        ran.append(s)
+        return "3 namespaces"
+
+    turn = TurnConveyor({"kubectl": kubectl},
+                        schema=TOOLPROMPT_SCHEMA)
+    text = tp_json()
+    cut = text.index('"observation"')
+    turn.on_delta(text[:cut])
+    # The input field closed -> the bet is already in flight, while the
+    # observation/final_answer tail is still "decoding".
+    assert turn.launch is not None
+    assert turn.launch.name == "kubectl"
+    assert turn.launch.input == "kubectl get ns"
+    assert obs.TOOL_EARLY_LAUNCHES.value(tool="kubectl") == 1.0
+    turn.on_delta(text[cut:])
+    turn.finish_stream()
+    assert turn.launch.result() == "3 namespaces"
+    assert ran == ["kubectl get ns"]
+    assert turn.overlap_s() >= 0.0
+    enter = tool_events(obs.flight.get_recorder().snapshot())[0]
+    assert enter["phase"] == "enter"
+    assert enter["conveyor"] is True
+    assert enter["launch_offset_ms"] >= 0.0
+    assert "opsagent_tool_launch_lead_seconds" in obs.metrics_text()
+
+
+def test_turn_conveyor_ignores_unknown_tool_and_missing_fields():
+    turn = TurnConveyor({"kubectl": lambda s: "ok"},
+                        schema=TOOLPROMPT_SCHEMA)
+    turn.on_delta(tp_json(name="not-a-tool"))
+    assert turn.launch is None
+    assert obs.TOOL_EARLY_LAUNCHES.value(tool="not-a-tool") == 0.0
+    # Name closed but input still streaming: no launch yet.
+    turn2 = TurnConveyor({"kubectl": lambda s: "ok"},
+                         schema=TOOLPROMPT_SCHEMA)
+    text = tp_json()
+    turn2.on_delta(text[:text.index('"input"')])
+    assert turn2.launch is None
+
+
+def test_turn_conveyor_abort_records_cancelled_exit():
+    turn = TurnConveyor({"kubectl": lambda s: "ok"},
+                        schema=TOOLPROMPT_SCHEMA)
+    text = tp_json()
+    turn.on_delta(text[:text.index('"observation"')])
+    assert turn.launch is not None
+    turn.abort()
+    evs = tool_events(obs.flight.get_recorder().snapshot())
+    assert [e["phase"] for e in evs] == ["enter", "exit"]
+    assert evs[1]["outcome"] == "cancelled"
+    assert evs[1]["conveyor"] is True
+
+
+# -- ReAct loop integration --------------------------------------------------
+
+
+def _scripted_replies(tool_input="kubectl get ns"):
+    return [
+        tp_json(tool_input=tool_input),
+        tp_json(name="", tool_input="", observation="3",
+                final="There are 3 namespaces in the cluster."),
+    ]
+
+
+def _fake_stream(replies, chunk=7, delay_s=0.003):
+    """Stand-in for conveyor.stream_constrained_turn: feeds each scripted
+    reply through on_delta in small paced chunks so a launched tool gets
+    a real decode tail to overlap with."""
+    it = iter(replies)
+
+    def fake(model, max_tokens, messages, response_format, on_delta):
+        text = next(it)
+        for i in range(0, len(text), chunk):
+            on_delta(text[i:i + chunk])
+            time.sleep(delay_s)
+        return text
+
+    return fake
+
+
+def _fake_chat(replies):
+    it = iter(replies)
+
+    def fake(self, model, max_tokens, messages, **kw):
+        return next(it)
+
+    return fake
+
+
+def _run_react(monkeypatch, fake_tools, replies, conveyor_on, tool,
+               model="tpu://convey-test"):
+    monkeypatch.setenv("OPSAGENT_CONVEYOR", "1" if conveyor_on else "0")
+    fake_tools({"kubectl": tool})
+    if conveyor_on:
+        monkeypatch.setattr(
+            conveyor, "stream_constrained_turn", _fake_stream(replies)
+        )
+    else:
+        from opsagent_tpu.llm.client import ChatClient
+
+        monkeypatch.setattr(ChatClient, "chat", _fake_chat(replies))
+    messages = [
+        {"role": "system", "content": "you are a test agent"},
+        {"role": "user", "content": "count namespaces"},
+    ]
+    return react.assistant_with_config(
+        model, messages, 256, False, False, 4, "", ""
+    )
+
+
+def test_react_conveyor_transcripts_identical_on_vs_off(
+    monkeypatch, fake_tools
+):
+    """The tentpole's correctness bar: the conveyor changes WHEN the tool
+    runs, never what the agent says — transcripts are byte-identical."""
+    def kubectl(s):
+        time.sleep(0.12)
+        return "namespace-a\nnamespace-b\nnamespace-c"
+
+    out_on, hist_on = _run_react(
+        monkeypatch, fake_tools, _scripted_replies(), True, kubectl
+    )
+    early = obs.TOOL_EARLY_LAUNCHES.value(tool="kubectl")
+    overlap = obs.TOOL_OVERLAP_SECONDS.value()
+    assert early == 1.0
+    assert overlap > 0.0
+    evs = tool_events(obs.flight.get_recorder().snapshot())
+    assert [e["phase"] for e in evs] == ["enter", "exit"]
+    assert evs[0]["conveyor"] is True and "launch_offset_ms" in evs[0]
+    assert evs[1]["outcome"] == "ok"
+    assert evs[1]["overlap_ms"] > 0.0
+
+    out_off, hist_off = _run_react(
+        monkeypatch, fake_tools, _scripted_replies(), False, kubectl
+    )
+    # No new early launches in the off phase.
+    assert obs.TOOL_EARLY_LAUNCHES.value(tool="kubectl") == early
+    assert obs.TOOL_OVERLAP_SECONDS.value() == overlap
+    assert out_on == out_off
+    assert [(m["role"], m["content"]) for m in hist_on] == \
+        [(m["role"], m["content"]) for m in hist_off]
+    assert "3 namespaces" in json.loads(out_on)["final_answer"]
+
+
+def test_react_conveyor_chaos_falls_back_to_classic(
+    monkeypatch, fake_tools
+):
+    """tool.exec fault mid-overlap: the early launch dies, the classic
+    relaunch completes the turn, the transcript is unchanged — zero lost
+    tokens, both flight pairs on record."""
+    calls = []
+
+    def kubectl(s):
+        calls.append(s)
+        return "namespace-a\nnamespace-b\nnamespace-c"
+
+    base_out, base_hist = _run_react(
+        monkeypatch, fake_tools, _scripted_replies(), True, kubectl
+    )
+    assert calls == ["kubectl get ns"]
+    obs.flight.get_recorder().reset()
+
+    calls.clear()
+    faults.configure("tool.exec@1")  # hit 1 = the conveyor worker
+    try:
+        out, hist = _run_react(
+            monkeypatch, fake_tools, _scripted_replies(), True, kubectl
+        )
+    finally:
+        faults.reset()
+    # The worker died before reaching the tool; the classic path ran it.
+    assert calls == ["kubectl get ns"]
+    assert out == base_out
+    assert [(m["role"], m["content"]) for m in hist] == \
+        [(m["role"], m["content"]) for m in base_hist]
+    evs = tool_events(obs.flight.get_recorder().snapshot())
+    assert [(e["phase"], e.get("conveyor", False)) for e in evs] == [
+        ("enter", True), ("exit", True),   # the failed early launch
+        ("enter", False), ("exit", False),  # the classic relaunch
+    ]
+    assert evs[1]["outcome"] == "error"
+    assert "injected tool subprocess" in evs[1]["error"]
+    assert evs[3]["outcome"] == "ok"
+    assert obs.TOOL_CALLS.value(tool="kubectl", outcome="error") == 1.0
+    assert obs.TOOL_CALLS.value(tool="kubectl", outcome="ok") >= 1.0
+
+
+def test_react_conveyor_mismatch_cancels_and_reruns(
+    monkeypatch, fake_tools
+):
+    """Launched prefix != final parse: the bet is cancelled and the
+    classic path runs the PARSED call; the flight ring records both."""
+    from opsagent_tpu.tools import ToolPrompt
+
+    class Tampering:
+        """Parse wrapper that diverges from the streamed prefix."""
+
+        @staticmethod
+        def from_json(text):
+            tp = ToolPrompt.from_json(text)
+            if tp.action.name:
+                tp.action.input = tp.action.input + " --tampered"
+            return tp
+
+    monkeypatch.setattr(react, "ToolPrompt", Tampering)
+    calls = []
+
+    def kubectl(s):
+        time.sleep(0.05)
+        calls.append(s)
+        return "namespace-a"
+
+    out, hist = _run_react(
+        monkeypatch, fake_tools, _scripted_replies(), True, kubectl
+    )
+    # Both flights ran: the cancelled speculative input and the parsed one.
+    assert sorted(calls) == [
+        "kubectl get ns", "kubectl get ns --tampered",
+    ]
+    evs = tool_events(obs.flight.get_recorder().snapshot())
+    assert [(e["phase"], e.get("conveyor", False)) for e in evs] == [
+        ("enter", True), ("exit", True),
+        ("enter", False), ("exit", False),
+    ]
+    assert evs[1]["outcome"] == "cancelled"
+    assert evs[3]["outcome"] == "ok"
+    assert "3 namespaces" in json.loads(out)["final_answer"]
+
+
+def test_react_conveyor_aborts_on_final_answer_turn(
+    monkeypatch, fake_tools
+):
+    """A reply that dispatches no tool leaves no dangling launch."""
+    replies = [tp_json(name="", tool_input="", observation="seen",
+                       final="Nothing to do here, all healthy.")]
+    out, hist = _run_react(
+        monkeypatch, fake_tools, replies, True, lambda s: "never"
+    )
+    assert json.loads(out)["final_answer"].startswith("Nothing")
+    assert obs.TOOL_EARLY_LAUNCHES.value(tool="kubectl") == 0.0
+    assert tool_events(obs.flight.get_recorder().snapshot()) == []
+
+
+# -- park-at-launch accounting ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_park_at_launch_frees_pages_for_readmission():
+    """The launch parks the session's KV to the host tier: parked_tokens
+    must match the allocator's page delta exactly, and the freed HBM must
+    be re-admittable while the tool overlaps the decode tail."""
+    import jax.numpy as jnp
+
+    from opsagent_tpu.serving import api as serving_api
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(
+        model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+        num_pages=128, max_pages_per_seq=32, max_batch_size=2,
+        prefill_buckets=(128, 256), max_new_tokens_default=8,
+        offload=True,
+    ))
+    stack = serving_api.ServingStack(eng)
+    serving_api.install_stack("convey-park", stack)
+    try:
+        messages = [
+            {"role": "system", "content": "you are a cluster assistant "
+                                          "counting namespaces"},
+            {"role": "user", "content": "how many namespaces does the "
+                                        "cluster have right now?"},
+        ]
+        # Populate the prefix trie with this session's chain.
+        stack.chat_completion({
+            "model": "convey-park", "messages": messages,
+            "max_tokens": 8, "temperature": 0.0,
+        })
+        acct0 = eng.alloc.accounting()
+        turn = TurnConveyor(
+            {"kubectl": lambda s: "3"}, model="tpu://convey-park",
+            park_messages=messages, schema=TOOLPROMPT_SCHEMA,
+        )
+        text = tp_json()
+        turn.on_delta(text[:text.index('"observation"')])
+        assert turn.launch is not None
+        acct1 = eng.alloc.accounting()
+        pages_freed = acct1["free"] - acct0["free"]
+        assert turn.parked_tokens > 0
+        assert turn.parked_tokens == pages_freed * eng.cfg.page_size
+        enter = tool_events(obs.flight.get_recorder().snapshot())[0]
+        assert enter["parked_tokens"] == turn.parked_tokens
+        # The freed pages are usable DURING the overlap window.
+        r = stack.chat_completion({
+            "model": "convey-park",
+            "messages": [{"role": "user", "content": "another session "
+                          "admitted while the tool overlaps decode"}],
+            "max_tokens": 8, "temperature": 0.0,
+        })
+        assert r["choices"][0]["message"]["content"] is not None
+        turn.on_delta(text[text.index('"observation"'):])
+        turn.finish_stream()
+        assert turn.launch.result() == "3"
+        turn.record_exit("ok", overlap_s=turn.overlap_s())
+        evs = tool_events(obs.flight.get_recorder().snapshot())
+        assert evs[-1]["outcome"] == "ok"
+        assert evs[-1]["parked_tokens"] == turn.parked_tokens
+    finally:
+        serving_api.uninstall_stack("convey-park")
+        stack.close()
+
+
+# -- timeline overlap windows ------------------------------------------------
+
+
+def test_timeline_overlap_windows_and_gantt():
+    """assemble() intersects conveyor tool windows with decode_active and
+    reports the hidden time; render_gantt adds a tool_overlap row."""
+    from opsagent_tpu.obs.timeline import assemble, render_gantt
+
+    rec = obs.flight.get_recorder()
+    rid = "req-convey-tl"
+    base = time.perf_counter()
+    for kind, dt, kw in (
+        ("admission", 0.0, dict(seq_id=991, prompt_tokens=8)),
+        ("ttft", 0.030, dict(seq_id=991, ttft_ms=30.0)),
+        ("tool_exec", 0.040, dict(tool="kubectl", phase="enter",
+                                  conveyor=True, launch_offset_ms=12.0)),
+        ("tool_exec", 0.070, dict(tool="kubectl", phase="exit",
+                                  outcome="ok", duration_ms=30.0,
+                                  conveyor=True)),
+        ("finish", 0.100, dict(seq_id=991, tokens=9,
+                               finish_reason="stop")),
+    ):
+        e = rec.record(kind, request_id=rid, **kw)
+        e["ts"] = base + dt
+    tl = assemble(rid)
+    assert tl is not None
+    # Tool 40..70 ms entirely inside decode 30..100 ms.
+    assert abs(tl["tool_overlap_ms"] - 30.0) < 1.0
+    w = tl["overlap_windows"][0]
+    assert w["tool"] == "kubectl"
+    assert abs(w["start_ms"] - 40.0) < 1.0
+    assert abs(w["end_ms"] - 70.0) < 1.0
+    assert abs(w["duration_ms"] - 30.0) < 1.0
+    g = render_gantt(tl)
+    assert "tool_overlap" in g
+    assert "tool=kubectl" in g
+    assert "tool overlap hidden behind decode" in g
+
+
+def test_timeline_classic_tool_window_has_no_overlap_rows():
+    """Non-conveyor tool windows (the blocking path) must not count as
+    overlap: the decode was NOT running under them."""
+    from opsagent_tpu.obs.timeline import assemble, render_gantt
+
+    rec = obs.flight.get_recorder()
+    rid = "req-classic-tl"
+    base = time.perf_counter()
+    for kind, dt, kw in (
+        ("admission", 0.0, dict(seq_id=992, prompt_tokens=8)),
+        ("ttft", 0.010, dict(seq_id=992, ttft_ms=10.0)),
+        ("tool_exec", 0.020, dict(tool="kubectl", phase="enter")),
+        ("tool_exec", 0.040, dict(tool="kubectl", phase="exit",
+                                  outcome="ok", duration_ms=20.0)),
+        ("finish", 0.050, dict(seq_id=992, tokens=4,
+                               finish_reason="stop")),
+    ):
+        e = rec.record(kind, request_id=rid, **kw)
+        e["ts"] = base + dt
+    tl = assemble(rid)
+    assert tl is not None
+    assert tl["tool_overlap_ms"] == 0.0
+    assert tl["overlap_windows"] == []
+    assert "tool_overlap" not in render_gantt(tl)
+
+
+# -- trained-agent e2e -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_trained_agent_e2e_gantt_shows_overlap(tmp_path, monkeypatch):
+    """The acceptance gate end to end on real machinery: train the tiny
+    agent to memorization, serve it, run the episode with the conveyor
+    on — the launch must fire mid-decode, the transcript must match the
+    conveyor-off run byte for byte, and the timeline gantt must show the
+    tool window overlapping the decode span."""
+    import jax.numpy as jnp
+
+    from opsagent_tpu.agent.react import assistant_with_config
+    from opsagent_tpu.obs.timeline import assemble, render_gantt
+    from opsagent_tpu.serving import api as serving_api
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.tools.replay import (
+        NAMESPACES_SCRIPT,
+        install_replay_kubectl,
+    )
+
+    scripts = os.path.join(REPO, "scripts")
+    sys.path.insert(0, scripts)
+    try:
+        from train_tiny_agent import (
+            INSTRUCTION,
+            SYS_PROMPT,
+            train_checkpoint,
+        )
+    finally:
+        sys.path.remove(scripts)
+
+    ckpt, tok_path, cfg, loss, _ = train_checkpoint(str(tmp_path))
+    assert loss < 0.05, f"tiny agent failed to memorize: loss={loss}"
+
+    monkeypatch.setenv("PATH", os.environ["PATH"])
+    install_replay_kubectl(NAMESPACES_SCRIPT, str(tmp_path / "bin"))
+    real_kubectl = tools_pkg.get_tools()["kubectl"]
+
+    def paced_kubectl(arg):
+        time.sleep(0.15)  # a real execution window to hide
+        return real_kubectl(arg)
+
+    monkeypatch.setitem(tools_pkg.copilot_tools, "kubectl", paced_kubectl)
+
+    eng = Engine(
+        EngineConfig(
+            model="tiny-test", checkpoint=ckpt, tokenizer=tok_path,
+            dtype=jnp.float32, num_pages=512, page_size=16,
+            max_pages_per_seq=64, max_batch_size=2,
+            prefill_buckets=(128, 512, 1024),
+        ),
+        model_cfg=cfg,
+    )
+    stack = serving_api.ServingStack(eng)
+    serving_api.install_stack("convey-e2e", stack)
+    messages = [
+        {"role": "system", "content": SYS_PROMPT},
+        {"role": "user",
+         "content": f"Here are the instructions: {INSTRUCTION}"},
+    ]
+    try:
+        results = {}
+        for tag in ("on", "off"):
+            monkeypatch.setenv(
+                "OPSAGENT_CONVEYOR", "1" if tag == "on" else "0"
+            )
+            rid = obs.new_request_id("convey")
+            with obs.trace_request(rid):
+                out, hist = assistant_with_config(
+                    "tpu://convey-e2e", [dict(m) for m in messages],
+                    256, False, False, 4, "", "",
+                )
+            results[tag] = (rid, out,
+                            [(m["role"], m["content"]) for m in hist])
+        assert results["on"][1] == results["off"][1]
+        assert results["on"][2] == results["off"][2]
+        assert obs.TOOL_EARLY_LAUNCHES.value(tool="kubectl") >= 1.0
+        assert obs.TOOL_OVERLAP_SECONDS.value() > 0.0
+
+        tl = assemble(results["on"][0])
+        assert tl is not None
+        assert tl["tool_overlap_ms"] > 0.0, tl["phases"]
+        assert tl["overlap_windows"][0]["tool"] == "kubectl"
+        g = render_gantt(tl)
+        assert "tool_overlap" in g and "tool=kubectl" in g
+
+        tl_off = assemble(results["off"][0])
+        assert tl_off is not None
+        assert tl_off["tool_overlap_ms"] == 0.0
+    finally:
+        serving_api.uninstall_stack("convey-e2e")
+        stack.close()

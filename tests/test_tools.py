@@ -78,12 +78,12 @@ def test_jq_no_pipe():
 
 def test_jq_fallback_path_eval(monkeypatch):
     # Force the built-in evaluator even when a jq binary exists.
-    import subprocess
+    from opsagent_tpu.tools import proc
 
     def no_jq(*a, **k):
         raise FileNotFoundError("jq")
 
-    monkeypatch.setattr(subprocess, "run", no_jq)
+    monkeypatch.setattr(proc, "run", no_jq)
     assert jq('{"a": {"b": [10, 20]}} | .a.b[1]') == "20"
     assert jq('{"items": [{"n": 1}, {"n": 2}]} | .items[].n') == "1\n2"
     assert jq('[1, 2, 3] | length') == "3"
@@ -109,7 +109,7 @@ def test_kubectl_noise_filter():
 
 
 def test_trivy_strips_image_prefix(monkeypatch):
-    import subprocess
+    from opsagent_tpu.tools import proc
 
     captured = {}
 
@@ -123,7 +123,7 @@ def test_trivy_strips_image_prefix(monkeypatch):
 
         return R()
 
-    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(proc, "run", fake_run)
     assert trivy("image nginx:1.25") == "no vulns"
     assert captured["argv"][:3] == ["trivy", "image", "nginx:1.25"]
 
