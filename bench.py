@@ -265,8 +265,13 @@ def _cpu_env() -> dict:
     return {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
 
 
-def _run_child(env_extra: dict, timeout_s: float, tag: str) -> dict | None:
-    """Run one bench preset in a subprocess; return its parsed JSON line.
+def _run_child_rows(
+    env_extra: dict, timeout_s: float, tag: str
+) -> list[dict]:
+    """Run one bench preset in a subprocess; return EVERY parsed JSON
+    row it printed, in print order. Multi-row stages (the ragged sweep
+    prints one row per cell) need all of them; single-row stages take
+    the last via ``_run_child``.
 
     Subprocess isolation means a wedged device link or OOM in one preset
     cannot take down the other's already-collected result. The child's
@@ -278,7 +283,7 @@ def _run_child(env_extra: dict, timeout_s: float, tag: str) -> dict | None:
 
     if timeout_s < 60:
         log(f"bench[{tag}]: skipped ({timeout_s:.0f}s left is too little)")
-        return None
+        return []
     env = dict(os.environ, _OPSAGENT_BENCH_CHILD="1")
     for k, v in env_extra.items():
         if v is None:
@@ -305,15 +310,25 @@ def _run_child(env_extra: dict, timeout_s: float, tag: str) -> dict | None:
         proc.kill()
         proc.wait()
     reader.join(timeout=10)
-    for line in reversed(lines):
+    rows: list[dict] = []
+    for line in lines:
         try:
             parsed = json.loads(line)
-            if "metric" in parsed:
-                return parsed
         except json.JSONDecodeError:
             continue
-    log(f"bench[{tag}]: no JSON result (rc={proc.returncode})")
-    return None
+        if isinstance(parsed, dict) and "metric" in parsed:
+            rows.append(parsed)
+    if not rows:
+        log(f"bench[{tag}]: no JSON result (rc={proc.returncode})")
+    return rows
+
+
+def _run_child(env_extra: dict, timeout_s: float, tag: str) -> dict | None:
+    """Single-row form of ``_run_child_rows``: the LAST parsed row is
+    the stage's result (children print their summary line last — the
+    same contract the driver applies to the orchestrator itself)."""
+    rows = _run_child_rows(env_extra, timeout_s, tag)
+    return rows[-1] if rows else None
 
 
 def run_orchestrated() -> None:
@@ -370,6 +385,23 @@ def run_orchestrated() -> None:
         if r is not None:
             print(json.dumps(r), flush=True)
         return r
+
+    def stage_rows(env_extra: dict, min_remaining: float, tag: str,
+                   cap: float | None = None) -> list[dict]:
+        """Multi-row stage: flush EVERY row the child earned, in order,
+        the moment the child exits (the sweep's per-cell rows are each a
+        first-class perf-gate series — losing all-but-last would reduce
+        the sweep to a single backend's number)."""
+        if remaining() <= min_remaining:
+            log(f"bench: skipping {tag} ({remaining():.0f}s left)")
+            return []
+        timeout_s = remaining() - 10
+        if cap is not None:
+            timeout_s = min(cap, timeout_s)
+        rows = _run_child_rows({**base, **env_extra}, timeout_s, tag)
+        for r in rows:
+            print(json.dumps(r), flush=True)
+        return rows
 
     stage1_cap = float(os.environ.get("OPSAGENT_BENCH_STAGE1_CAP", "390"))
     # Whatever the budget, stage 1 must leave room for the cpu fallback
@@ -553,6 +585,21 @@ def run_orchestrated() -> None:
     ) if rdma is not None and r8bkv is not None else None
     if rdmakv is not None and rdmakv["value"] > headline["value"]:
         headline = rdmakv
+    # Ragged-backend sweep (ISSUE 15): the MIXED hot path (step_mixed →
+    # paged_ragged_attention_auto) timed per backend × KV dtype × weight
+    # quant on the bench-8b shape, one tok/s/chip row per cell with
+    # self-describing resolved-impl extras. The dma stages above time
+    # the legacy block-decode path; this stage times what serving
+    # actually runs. Last row is the child's best-cell summary —
+    # promote-if-faster like the int4 stage.
+    sweep_rows = stage_rows(
+        {"OPSAGENT_BENCH_MODE": "ragged-sweep",
+         "OPSAGENT_BENCH_MODEL": "bench-8b"},
+        320, "ragged-sweep",
+    ) if on_tpu and r8b is not None else []
+    rsweep = sweep_rows[-1] if sweep_rows else None
+    if rsweep is not None and rsweep["value"] > headline["value"]:
+        headline = rsweep
     # Cold-restart TTFT proof (VERDICT r03 #9): stage 1 primed the
     # persistent compilation cache; this fresh process re-inits the same
     # preset, so its init_s/warmup_s/first_ttft_ms ARE the
@@ -708,6 +755,15 @@ def run_orchestrated() -> None:
         extra["pallas_dma_tok_s_chip"] = rdma["value"]
     if rdmakv is not None and headline is not rdmakv:
         extra["pallas_dma_kv_int8_tok_s_chip"] = rdmakv["value"]
+    if rsweep is not None:
+        se = rsweep.get("extra", {})
+        if headline is not rsweep:
+            extra["ragged_sweep_best_tok_s_chip"] = rsweep["value"]
+        extra["ragged_sweep_best_cell"] = se.get("best_cell")
+        extra["ragged_sweep_outputs_identical"] = se.get(
+            "outputs_identical"
+        )
+        extra["ragged_sweep_cells"] = se.get("cells")
     if rcold is not None:
         ce = rcold.get("extra", {})
         extra["cold_restart_first_ttft_ms"] = ce.get("first_ttft_ms")
@@ -738,7 +794,7 @@ def run_orchestrated() -> None:
     exit_if_perf_regression([
         r1, r8b, r8b4, r8bkv, r8b4kv, rsess, rsessmix, rsessasync,
         rsessoff, rfleet, rchaos, rfgkv, ragent, rconvey, rdma, rdmakv,
-        rcold, rcoldstart, rspec,
+        rcold, rcoldstart, rspec, *sweep_rows,
     ])
 
 
@@ -784,6 +840,13 @@ def run_single() -> None:
         # tokenizer, trained weights) — intercept before the shared
         # construction below.
         run_agent_conveyor(platform, n_chips)
+        return
+    if mode == "ragged-sweep":
+        # Builds one engine per (backend x KV dtype x weight quant) cell
+        # with its own geometry — intercept before the shared
+        # construction below.
+        run_ragged_sweep(platform, n_chips, model, batch, steps,
+                         prompt_len)
         return
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "sessions-ffwd", "fleet-affinity",
@@ -1024,7 +1087,8 @@ def run_single() -> None:
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
-            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
             "decode_block": eng.cfg.decode_block,
             "page_size": eng.cfg.page_size,
             "metrics": metrics_snapshot(),
@@ -1128,6 +1192,176 @@ def run_cold_start(cfg, model, batch, steps, prompt_len, platform,
     shutil.rmtree(work, ignore_errors=True)
 
 
+def run_ragged_sweep(platform, n_chips, model, batch, steps,
+                     prompt_len) -> None:
+    """Ragged-backend sweep (ROADMAP item 1): time the MIXED hot path —
+    sync ``step_mixed`` ticks, the program serving actually runs — across
+    attention backend x KV page dtype x weight quant cells on one model
+    shape, one self-describing tok/s/chip row per cell.
+
+    Each cell builds its own engine (the backend env var and quant modes
+    are engine-construction inputs), warms exactly the mixed program
+    family ("bench-mixed" level), admits ``batch`` identical greedy
+    prompts through chunked mixed admission, then times ``steps``
+    decode-only mixed ticks. Within a (weight, KV) group the xla cell is
+    the oracle: every other backend's full greedy token streams must be
+    byte-identical, and that verdict rides each row's extra. Off-chip
+    the Pallas cells run in interpret mode (no Mosaic on CPU), which is
+    exactly what the CI smoke exercises; on chip the rows answer the
+    r04 open question — whether streaming int8 pages through the ragged
+    DMA kernel tracks the attribution model's halved bytes floor.
+
+    Rows are flushed the moment they exist (driver-kill contract), and
+    the LAST line is a copy of the best cell with the per-cell values
+    folded into extra — the orchestrator's promote-if-faster input."""
+    import gc
+
+    from opsagent_tpu import obs
+    from opsagent_tpu.serving.engine import Engine, EngineConfig
+    from opsagent_tpu.serving.sampler import SamplingParams
+
+    on_tpu = platform == "tpu"
+    budget = float(os.environ.get(
+        "OPSAGENT_BENCH_SWEEP_BUDGET", "600" if on_tpu else "240"
+    ))
+    t_start = time.perf_counter()
+    if not on_tpu:
+        # No Mosaic off-chip: run the Pallas cells in interpret mode so
+        # the full chain (engine impl gate -> auto dispatcher -> ragged
+        # DMA kernel) still executes end to end on CPU.
+        os.environ["OPSAGENT_PALLAS_INTERPRET"] = "1"
+    backends = ("xla", "pallas", "pallas-dma")
+    kv_modes = ("", "int8")
+    # Off-chip cells keep fp32 weights: the question CPU answers is
+    # dispatch-equivalence, not throughput, and weight quant doubles the
+    # cell count without touching the attention path under test.
+    weight_modes = ("int8", "int4") if on_tpu else ("",)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    steps = min(steps, 256)
+    chunk = 64 if on_tpu else 16
+    buckets = tuple(sorted({4, chunk}))
+    page_size = int(os.environ.get("OPSAGENT_BENCH_PAGE", "64"))
+    # +1 page of slack over prompt+generated: the settle tick plus the
+    # decode rows' one-token booking must never hit OutOfPages (which
+    # would truncate rows and quietly deflate the number).
+    max_pages = -(-(prompt_len + steps + 2) // page_size) + 1
+    num_pages = max(batch * max_pages, 64)
+    sampling = SamplingParams(temperature=0.0, max_tokens=10**9)
+
+    cells = [
+        (wq, kv, backend)
+        for wq in weight_modes for kv in kv_modes for backend in backends
+    ]
+    rows: list[dict] = []
+    oracle: dict[tuple, list[list[int]]] = {}
+    groups_ok: dict[tuple, bool] = {}
+    for wq, kv, backend in cells:
+        label = f"{backend}/{wq or 'bf16'}/kv-{kv or 'bf16'}"
+        elapsed = time.perf_counter() - t_start
+        if rows and elapsed > budget:
+            log(f"bench[ragged-sweep]: {elapsed:.0f}s > {budget:.0f}s "
+                f"budget; dropping {label} and later cells")
+            break
+        os.environ["OPSAGENT_PAGED_BACKEND"] = backend
+        cfg = EngineConfig(
+            model=model,
+            dtype=dtype,
+            max_batch_size=batch,
+            num_pages=num_pages,
+            page_size=page_size,
+            max_pages_per_seq=max_pages,
+            prefill_buckets=(prompt_len,),
+            quantize=wq,
+            kv_quantize=kv,
+            mixed_batching=True,
+            async_depth=1,
+            mixed_buckets=buckets,
+        )
+        eng = Engine(cfg)
+        warmup_s = eng.warmup("bench-mixed")
+        compiles0 = obs.POST_WARMUP_COMPILES.value()
+        rng = np.random.default_rng(0)
+        vocab = eng.model_cfg.vocab_size
+        ids = [
+            eng.begin_request(
+                rng.integers(1, vocab, size=prompt_len).tolist(), sampling
+            )
+            for _ in range(batch)
+        ]
+        while eng._prefilling:
+            chunks = {}
+            for sid in list(eng._prefilling):
+                done, total = eng.prefill_progress(sid)
+                chunks[sid] = min(chunk, total - done)
+            eng.step_mixed([], chunks)
+        # One settle tick outside the window (donation/layout settle),
+        # then `steps` timed decode-only mixed ticks — every tick is ONE
+        # dispatch advancing all `batch` lanes through the cell's kernel.
+        eng.step_mixed(ids, {})
+        produced = 0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out, _ = eng.step_mixed(ids, {})
+            produced += sum(len(v) for v in out.values())
+        dt = time.perf_counter() - t0
+        post_compiles = int(obs.POST_WARMUP_COMPILES.value() - compiles0)
+        tok_s = produced / dt
+        tok_s_chip = tok_s / n_chips
+        outputs = [list(eng.sequences[s].tokens) for s in ids]
+        group = (wq, kv)
+        if backend == "xla":
+            oracle[group] = outputs
+            identical = True
+        else:
+            identical = outputs == oracle.get(group)
+        groups_ok[group] = groups_ok.get(group, True) and identical
+        info = eng.impl_info()
+        row = {
+            "metric": (
+                f"mixed_ragged_throughput[{model},{wq or 'bf16'},"
+                f"kv-{kv or 'bf16'},{backend},B={batch},{platform}]"
+            ),
+            "value": round(tok_s_chip, 1),
+            "unit": "tok/s/chip",
+            "vs_baseline": None,
+            "extra": {
+                "total_tok_s": round(tok_s, 1),
+                "requested_backend": backend,
+                **info,
+                "outputs_identical": identical,
+                "post_warmup_compiles": post_compiles,
+                "warmup_s": round(warmup_s, 1),
+                "steps": steps,
+                "interpret": not on_tpu,
+                "paged_backend": info["attn_impl"],
+                "chips": n_chips,
+                "platform": platform,
+            },
+        }
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+        log(f"bench[ragged-sweep/{label}]: resolved={info['attn_impl']} "
+            f"{tok_s_chip:.0f} tok/s/chip, identical={identical}, "
+            f"post-warmup compiles {post_compiles}")
+        for sid in ids:
+            eng.finish(sid)
+        del eng
+        gc.collect()
+    if not rows:
+        raise SystemExit("bench[ragged-sweep]: no cell produced a number")
+    # Best-cell summary LAST: the orchestrator's last-JSON-line parse
+    # (and promote-if-faster fold) reads this row.
+    best = max(rows, key=lambda r: r["value"])
+    summary = dict(best, extra=dict(best["extra"]))
+    summary["extra"].update({
+        "best_cell": best["metric"],
+        "cells": len(rows),
+        "outputs_identical": all(groups_ok.values()),
+        "cell_tok_s_chip": {r["metric"]: r["value"] for r in rows},
+    })
+    print(json.dumps(summary), flush=True)
+
+
 def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
                  quantize, init_s, warmup_s) -> None:
     """BASELINE config 5: ``batch`` concurrent sessions through the FULL
@@ -1216,7 +1450,8 @@ def run_sessions(eng, model, batch, steps, prompt_len, platform, n_chips,
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
-            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
             "metrics": metrics_snapshot(),
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
@@ -1372,7 +1607,8 @@ def run_sessions_mixed(eng, model, batch, steps, prompt_len, platform,
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
-            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
             "metrics": metrics_snapshot(),
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
@@ -1466,7 +1702,8 @@ def run_sessions_async(eng, model, batch, steps, prompt_len, platform,
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
-            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
             "metrics": metrics_snapshot(),
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
@@ -1562,7 +1799,8 @@ def run_sessions_ffwd(eng, model, batch, steps, prompt_len, platform,
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
-            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
             "metrics": metrics_snapshot(),
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
@@ -1662,7 +1900,8 @@ def run_sessions_offload(eng, model, batch, steps, prompt_len, platform,
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
-            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
             "metrics": metrics_snapshot(),
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
@@ -1848,7 +2087,8 @@ def run_fleet_affinity(eng, cfg, model, batch, steps, prompt_len, platform,
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
-            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
             "metrics": snap,
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),
@@ -2386,7 +2626,8 @@ def run_agent_turns(eng, model, batch, prompt_len, platform, n_chips,
             "warmup_s": round(warmup_s, 1),
             "chips": n_chips,
             "platform": platform,
-            "paged_backend": os.environ.get("OPSAGENT_PAGED_BACKEND", ""),
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
             "metrics": metrics_snapshot(),
             "attribution": attribution_snapshot(),
             "slo": slo_verdicts(),

@@ -1112,7 +1112,7 @@ def decode_step(
     page_table: jax.Array,   # [B, MaxP]
     active: jax.Array,       # [B] bool; inactive slots skip the page write
     dtype: jnp.dtype = jnp.bfloat16,
-    attn_impl: str = "xla",  # "xla" | "pallas" (ops.paged_attention_backend)
+    attn_impl: str = "xla",  # xla | pallas | pallas-dma (paged_attention_backend)
     mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
 ) -> tuple[jax.Array, Params]:
     """One decode step for a batch of sequences; returns ([B, V] logits,

@@ -88,14 +88,17 @@ def paged_attention_backend() -> str:
 
     Default is "xla" EVERYWHERE — by measurement, not preference: the
     r01 on-chip comparison had the gather beating the grid kernel at
-    decode shapes (per-page pipeline-step overhead), and r04's only
-    successful on-chip runs (1B 4775 / 8B-int8 1899 tok/s/chip) are xla
-    numbers. "pallas-dma" exists to beat the gather's
-    capacity-proportional reads and is expected to become the TPU
-    default, but ONLY once the on-chip sweep (bench pallas-dma stages)
-    shows it winning — interpret-mode tests cover semantics, not Mosaic
-    lowering or speed, and its first compile attempt on hardware failed
-    (head_dim alignment, r04)."""
+    decode shapes (per-page pipeline-step overhead), and the committed
+    headline numbers are xla numbers. "pallas-dma" now covers BOTH hot
+    paths — decode (``paged_decode_attention_pallas_dma``) and the
+    mixed ragged step (``paged_ragged_attention_pallas_dma``), each
+    streaming int8 ``QuantizedPages`` at half the bytes — and the bench
+    ragged-backend sweep (xla vs pallas vs pallas-dma × KV dtype ×
+    weight quant) promotes it into the headline the moment an on-chip
+    run shows it winning; the default flips only on that evidence.
+    Interpret-mode tests cover semantics, not Mosaic lowering or speed,
+    and head_dim % 128 != 0 still rejects (r04 on-chip: Mosaic
+    manual-DMA alignment)."""
     choice = os.environ.get("OPSAGENT_PAGED_BACKEND", "auto")
     if choice in ("pallas", "pallas-dma", "xla"):
         return choice
@@ -105,6 +108,17 @@ def paged_attention_backend() -> str:
             f"pallas-dma, xla, or auto"
         )
     return "xla"
+
+
+def pallas_interpret() -> bool:
+    """Whether the Pallas kernels should run in interpret mode
+    (OPSAGENT_PALLAS_INTERPRET=1): the CPU escape hatch that lets the
+    bench ragged-backend sweep smoke and CI exercise the pallas /
+    pallas-dma dispatch paths end-to-end off-TPU, where a compiled
+    pallas_call cannot lower. Read at trace time by the ``*_auto``
+    dispatchers; never set it on real hardware (interpret mode is
+    orders of magnitude slower and skips Mosaic entirely)."""
+    return os.environ.get("OPSAGENT_PALLAS_INTERPRET", "") == "1"
 
 
 def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
@@ -136,6 +150,18 @@ def _pallas_kernel_fn(impl: str):
     return (
         paged_decode_attention_pallas_dma if impl == "pallas-dma"
         else paged_decode_attention_pallas
+    )
+
+
+def _ragged_pallas_kernel_fn(impl: str):
+    from .paged_attention_pallas import (
+        paged_ragged_attention_pallas,
+        paged_ragged_attention_pallas_dma,
+    )
+
+    return (
+        paged_ragged_attention_pallas_dma if impl == "pallas-dma"
+        else paged_ragged_attention_pallas
     )
 
 
@@ -208,13 +234,15 @@ def paged_decode_attention_auto(
         # (B, MaxP) grid kernel has no scale path.
         impl = "xla"
     if impl.startswith("pallas"):
+        interpret = pallas_interpret()
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             return paged_decode_attention_pallas_tp(
                 q, k_pages, v_pages, page_table, lengths, mesh, layer=layer,
-                impl=impl,
+                impl=impl, interpret=interpret,
             )
         return _pallas_kernel_fn(impl)(
-            q, k_pages, v_pages, page_table, lengths, layer=layer
+            q, k_pages, v_pages, page_table, lengths, layer=layer,
+            interpret=interpret,
         )
     return paged_decode_attention(
         q, k_pages, v_pages, page_table, lengths, layer=layer
@@ -502,13 +530,17 @@ def paged_ragged_attention_pallas_tp(
     mesh: Mesh,
     layer: jax.Array | None = None,
     interpret: bool = False,
+    impl: str = "pallas",
 ) -> jax.Array:
-    """The ragged Pallas kernel under tensor parallelism: shard_mapped over
-    ``tp`` exactly like ``paged_decode_attention_pallas_tp`` — query heads
-    and kv heads are both tp-sharded, the GQA group structure is preserved
-    per shard, and no collective is needed (the all-reduce happens later
-    at the wo row-parallel matmul)."""
-    from .paged_attention_pallas import paged_ragged_attention_pallas
+    """The ragged Pallas kernels under tensor parallelism: shard_mapped
+    over ``tp`` exactly like ``paged_decode_attention_pallas_tp`` — query
+    heads and kv heads are both tp-sharded, the GQA group structure is
+    preserved per shard, and no collective is needed (the all-reduce
+    happens later at the wo row-parallel matmul). ``impl`` picks the grid
+    kernel ("pallas") or the manual-DMA streamer ("pallas-dma"); with
+    ``QuantizedPages`` the scale planes shard with their values' kv-head
+    axis, mirroring the decode TP wrapper."""
+    kernel = _ragged_pallas_kernel_fn(impl)
 
     spec_q = P(None, None, "tp", None)
     five_d = k_pages.ndim == 5
@@ -516,11 +548,18 @@ def paged_ragged_attention_pallas_tp(
         P(None, None, None, "tp", None) if five_d
         else P(None, None, "tp", None)
     )
+    if isinstance(k_pages, QuantizedPages):
+        # Scale planes shard with their values' kv-head axis (one fewer
+        # trailing dim); the spec pytree mirrors the QuantizedPages leaf.
+        spec_sc = (
+            P(None, None, None, "tp") if five_d else P(None, None, "tp")
+        )
+        spec_kv = QuantizedPages(spec_kv, spec_sc)
     if layer is None:
         layer = jnp.int32(0)
 
     def local(q, kp, vp, table, st, ql, ly):
-        return paged_ragged_attention_pallas(
+        return kernel(
             q, kp, vp, table, st, ql, interpret=interpret, layer=ly
         )
 
@@ -546,23 +585,28 @@ def paged_ragged_attention_auto(
     mesh: Mesh | None = None,
 ) -> jax.Array:
     """Impl-dispatched ragged paged attention (the mixed-step analogue of
-    ``paged_decode_attention_auto``). int8 KV pages and the manual-DMA
-    backend fall back to the XLA gather: the quantized-scale score trick
-    and the double-buffered page streamer are decode-only so far — a
-    ragged DMA variant is a follow-up once the on-chip sweep justifies
-    it."""
-    if isinstance(k_pages, QuantizedPages) or impl == "pallas-dma":
+    ``paged_decode_attention_auto``). "pallas-dma" dispatches to the
+    ragged manual-DMA streamer (``paged_ragged_attention_pallas_dma``),
+    which natively streams int8 ``QuantizedPages`` at half the bytes —
+    quantized pages on the mixed hot path are never materialized as a
+    dequantized contiguous gather. Only the (B, MaxP) grid kernel still
+    falls back to the XLA gather for quantized pages (it has no scale
+    path)."""
+    if isinstance(k_pages, QuantizedPages) and impl != "pallas-dma":
+        # int8+scale pages flow through the XLA gather or the ragged
+        # manual-DMA kernel (which streams int8 pages and applies scales
+        # in score space); the (B, MaxP) grid kernel has no scale path.
         impl = "xla"
-    if impl == "pallas":
+    if impl.startswith("pallas"):
+        interpret = pallas_interpret()
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             return paged_ragged_attention_pallas_tp(
                 q, k_pages, v_pages, page_table, start, q_lens, mesh,
-                layer=layer,
+                layer=layer, impl=impl, interpret=interpret,
             )
-        from .paged_attention_pallas import paged_ragged_attention_pallas
-
-        return paged_ragged_attention_pallas(
-            q, k_pages, v_pages, page_table, start, q_lens, layer=layer
+        return _ragged_pallas_kernel_fn(impl)(
+            q, k_pages, v_pages, page_table, start, q_lens, layer=layer,
+            interpret=interpret,
         )
     return paged_ragged_attention(
         q, k_pages, v_pages, page_table, start, q_lens, layer=layer

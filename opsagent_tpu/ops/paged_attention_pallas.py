@@ -13,9 +13,12 @@ actually resident. TWO kernels stream only the owned pages instead:
   through two VMEM slots with manually double-buffered ``make_async_copy``
   DMAs. One grid step per sequence; unowned page slots cost nothing.
 
-A third kernel, ``paged_ragged_attention_pallas``, generalizes the grid
-kernel to RAGGED queries (per-row q_len, causal inside the chunk) for the
-engine's mixed prefill+decode step — see its docstring.
+Two more kernels generalize the pair to RAGGED queries (per-row q_len,
+causal inside the chunk) for the engine's mixed prefill+decode step:
+``paged_ragged_attention_pallas`` (grid form) and
+``paged_ragged_attention_pallas_dma`` (manual-DMA form, the mixed hot
+path's bytes-diet kernel: int8 ``QuantizedPages`` stream through the
+double-buffered DMAs at half the bytes) — see their docstrings.
 
 Both use a flash-attention-style online softmax so nothing is
 materialized.
@@ -576,6 +579,279 @@ def paged_ragged_attention_pallas(
         page_table.astype(jnp.int32), start.astype(jnp.int32),
         q_lens.astype(jnp.int32), base_arr,
         q, k_pages, v_pages,
+    )
+    return out
+
+
+def _kernel_ragged_dma(
+    # scalar prefetch
+    table_ref,     # [B, MaxP] int32 page indices (-1 = unassigned)
+    start_ref,     # [B] int32 tokens already in cache (queries begin here)
+    qlens_ref,     # [B] int32 valid query rows (0 = inactive row)
+    base_ref,      # [1] int32 flat-page offset (layer * N; 0 without layers)
+    # blocks + scratch, order depending on ``quantized`` (see unpack below)
+    *refs,
+    page_size: int,
+    num_kv_heads: int,
+    max_pages: int,
+    quantized: bool = False,
+):
+    """``_kernel_dma``'s machinery under ``_kernel_ragged``'s mask: one
+    grid step per SEQUENCE, its pages double-buffered through two VMEM
+    slots, with S query rows per sequence and a per-row valid count — so
+    q_len=1 decode rows, q_len=chunk prefill rows, and q_len>1 ffwd
+    forced-run appends all stream through ONE program that reads only the
+    pages each row owns. Queries flatten to [S*H, D] (row r = position
+    r // H, head r % H) and the causal-inside-the-chunk mask composes
+    with the GQA group select in the same [S*H, P*K] score domain.
+
+    Inactive rows (q_len == 0) stream NOTHING — n = 0 skips the warmup
+    DMA and the loop, l stays 0, and the safe divide emits zeros the host
+    discards. Rows with s >= q_len under an n > 0 sequence keep finite
+    accumulators (exp(0) columns) and emit garbage, same as the grid
+    kernel.
+
+    ``quantized`` works exactly as in ``_kernel_dma``: int8 pages stream
+    through the DMAs at half the bytes while this sequence's
+    pre-flattened [1, MaxP, P*K] f32 scale planes ride the automatic
+    BlockSpec pipeline and apply as per-column multiplies in score/probs
+    space (column c = (token c//K, kv head c%K) — the flat scale vector's
+    exact order — so the multiply is mathematically identical to
+    dequantizing the page)."""
+    if quantized:
+        (q_ref, k_hbm, v_hbm, k_sc_ref, v_sc_ref, o_ref,
+         k_buf, v_buf, k_sem, v_sem, acc_ref, m_ref, l_ref) = refs
+    else:
+        (q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, k_sem, v_sem, acc_ref, m_ref, l_ref) = refs
+        k_sc_ref = v_sc_ref = None
+    b = pl.program_id(0)
+    P = page_size
+    K = num_kv_heads
+    S = q_ref.shape[1]
+    H = q_ref.shape[2]
+    G = H // K
+    D = q_ref.shape[-1]
+    start = start_ref[b]
+    qlen = qlens_ref[b]
+    total = start + qlen           # cache tokens incl. this chunk's writes
+    # Pages this row actually owns, clamped to the table width (same
+    # guard as _kernel_dma: a length beyond MaxP*P must not drive table
+    # reads past [B, MaxP] or start a DMA the loop never waits on).
+    n = jnp.where(
+        qlen > 0, jnp.minimum(pl.cdiv(total, P), max_pages), 0
+    )
+
+    def k_dma(slot, i):
+        page = jnp.maximum(table_ref[b, i], 0) + base_ref[0]
+        return pltpu.make_async_copy(
+            k_hbm.at[page], k_buf.at[slot], k_sem.at[slot]
+        )
+
+    def v_dma(slot, i):
+        page = jnp.maximum(table_ref[b, i], 0) + base_ref[0]
+        return pltpu.make_async_copy(
+            v_hbm.at[page], v_buf.at[slot], v_sem.at[slot]
+        )
+
+    @pl.when(n > 0)
+    def _warmup():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].reshape(S * H, D).astype(jnp.float32) * (D ** -0.5)
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n)
+        def _prefetch():
+            k_dma(1 - slot, i + 1).start()
+            v_dma(1 - slot, i + 1).start()
+
+        k_dma(slot, i).wait()
+        v_dma(slot, i).wait()
+
+        kf = k_buf[slot].reshape(P * K, D)
+        vf = v_buf[slot].reshape(P * K, D)
+        if quantized:
+            # int8 values <= 127 are exact in f32; the MXU dot runs on
+            # converted operands rather than a mixed int8 x f32 dot.
+            kf = kf.astype(jnp.float32)
+        s_full = jax.lax.dot_general(
+            q, kf,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                   # [S*H, P*K]
+        if quantized:
+            s_full = s_full * k_sc_ref[0, i][None, :]
+        # Column c holds (token i*P + c//K, kv head c%K); row r holds
+        # (query position start + r//H, query head r%H). Select the GQA
+        # group AND the ragged causal window in one mask.
+        col = jax.lax.broadcasted_iota(jnp.int32, (S * H, P * K), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (S * H, P * K), 0)
+        t = i * P + col // K
+        qpos = start + row // H
+        sel = (
+            (col % K == (row % H) // G)
+            & (t <= qpos)
+            & (t < total)
+            & (row // H < qlen)
+        )
+        s = jnp.where(sel, s_full, NEG_INF)                 # [S*H, P*K]
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(s - m_new)
+        l_new = alpha[:, 0] * l_ref[:, 0] + jnp.sum(probs, axis=-1)
+        pv = probs
+        if quantized:
+            # V scale folds into the probs the same way (per-column).
+            pv = probs * v_sc_ref[0, i][None, :]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            pv, vf.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+    l = l_ref[:, :1]
+    safe = jnp.where(l > 0.0, l, 1.0)
+    o_ref[0] = (acc_ref[:] / safe).reshape(S, H, D).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_ragged_attention_pallas_dma(
+    q: jax.Array,           # [B, S, H, D] right-padded ragged queries
+    k_pages: jax.Array,     # [N, P, K, D] — or [L, N, P, K, D] with layer
+    v_pages: jax.Array,     # like k_pages
+    page_table: jax.Array,  # [B, MaxP] int32
+    start: jax.Array,       # [B] int32 tokens already in cache per row
+    q_lens: jax.Array,      # [B] int32 valid query rows (0 = inactive)
+    interpret: bool = False,
+    layer: jax.Array | None = None,  # [] int32 with the layer-axis form
+) -> jax.Array:
+    """Manual-DMA ragged paged attention: grid ``(B,)``, double-buffered
+    page streaming, per-row query lengths — the mixed-step hot-path form
+    of ``paged_decode_attention_pallas_dma`` (same contract as
+    ``paged_ragged_attention_pallas``; correctness oracle
+    ``ops.attention.paged_ragged_attention``).
+
+    Requires ``head_dim % 128 == 0``: Mosaic's manual-DMA memref slices
+    must be 128-aligned on the minormost dim (r04 on-chip: head_dim=64
+    fails to compile). Callers with smaller heads should use the grid
+    kernel or the xla gather (engine auto-falls-back).
+
+    Accepts ``ops.attention.QuantizedPages``: int8 pages stream through
+    the manual DMAs at HALF the bytes, while this sequence's scale planes
+    — 1/D of the page bytes — are XLA-gathered outside, flattened to
+    [B, MaxP, P*K], and pipelined into VMEM as ordinary blocks; the
+    kernel applies them as per-column multiplies in score/probs space
+    (mathematically identical to dequantizing the pages — see
+    ``_kernel_ragged_dma``). int8 pages are therefore NEVER materialized
+    as a dequantized contiguous gather anywhere on this path."""
+    from .attention import QuantizedPages
+
+    if q.shape[-1] % 128 != 0 and not interpret:
+        raise ValueError(
+            f"pallas-dma needs head_dim % 128 == 0, got {q.shape[-1]}; "
+            f"use impl='pallas' or 'xla'"
+        )
+    k_scale = v_scale = None
+    if isinstance(k_pages, QuantizedPages):
+        k_pages, k_scale = k_pages.q, k_pages.scale
+        v_pages, v_scale = v_pages.q, v_pages.scale
+    if k_pages.ndim == 5:
+        Lr, N, P, K, D = k_pages.shape
+        k_pages = k_pages.reshape(Lr * N, P, K, D)
+        v_pages = v_pages.reshape(Lr * N, P, K, D)
+        if k_scale is not None:
+            k_scale = k_scale.reshape(Lr * N, P, K)
+            v_scale = v_scale.reshape(Lr * N, P, K)
+        base = (layer if layer is not None else 0) * N
+    else:
+        N, P, K, D = k_pages.shape
+        base = 0
+    B, S, H, _ = q.shape
+    MaxP = page_table.shape[1]
+    base_arr = jnp.full((1,), base, jnp.int32)
+    quantized = k_scale is not None
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, S, H, D), lambda b, t, st, ql, ba: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        # Per-sequence scale planes, gathered OUTSIDE the kernel (tiny:
+        # 4 bytes per D int8 values), FLATTENED to [B, MaxP, P*K] so the
+        # lane dim is naturally 128-aligned, applied as per-column
+        # multiplies in score space (see _kernel_ragged_dma). Same index
+        # math as the kernel's DMA (max(slot, 0) + base), so value and
+        # scale planes can never come from different pages for an
+        # unassigned (-1) slot.
+        safe_table = jnp.maximum(page_table, 0) + base
+        sc_spec = pl.BlockSpec(
+            (1, MaxP, P * K), lambda b, t, st, ql, ba: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [sc_spec, sc_spec]
+        operands += [
+            k_scale[safe_table].reshape(B, MaxP, P * K),
+            v_scale[safe_table].reshape(B, MaxP, P * K),
+        ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, S, H, D), lambda b, t, st, ql, ba: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, P, K, D), k_pages.dtype),
+            pltpu.VMEM((2, P, K, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((S * H, D), jnp.float32),
+            pltpu.VMEM((S * H, 128), jnp.float32),
+            pltpu.VMEM((S * H, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel_ragged_dma, page_size=P, num_kv_heads=K,
+            max_pages=MaxP, quantized=quantized,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * B * S * H * D * MaxP * P,
+            bytes_accessed=(
+                B * MaxP * P * K * D * 2 * k_pages.dtype.itemsize
+                + B * S * H * D * 2 * q.dtype.itemsize
+            ),
+            transcendentals=B * S * H * MaxP * P,
+        ),
+    )(
+        page_table.astype(jnp.int32), start.astype(jnp.int32),
+        q_lens.astype(jnp.int32), base_arr,
+        *operands,
     )
     return out
 

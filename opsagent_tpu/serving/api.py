@@ -724,6 +724,10 @@ def build_engine_app(stack: ServingStack, membership=None):
             "prefix_hit_tokens": eng.alloc.hit_tokens,
             "prefix_miss_tokens": eng.alloc.miss_tokens,
             "prefix_evictions": eng.alloc.evictions,
+            # RESOLVED execution modes (attn impl after every fallback
+            # gate, weight + KV quant): fleet snapshots and sweep readers
+            # self-describe instead of inferring backend from env.
+            "impl": eng.impl_info(),
         }
         if getattr(eng, "init_stats", None):
             # Cold-start provenance: how long weights + warmup took, and

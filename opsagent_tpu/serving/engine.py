@@ -522,21 +522,32 @@ class Engine:
             self.attn_impl = "xla"
         if cfg.kv_quantize and self.attn_impl not in ("xla", "pallas-dma"):
             # int8 pages + scales flow through the XLA gather or the
-            # manual-DMA kernel (int8 streaming + VMEM dequantize); the
-            # grid kernel has no scale path.
+            # manual-DMA kernels — BOTH hot paths now: decode
+            # (paged_decode_attention_pallas_dma) and the mixed ragged
+            # step (paged_ragged_attention_pallas_dma) stream int8 pages
+            # at half the bytes with score-space scales. Only the
+            # (B, MaxP) grid kernel has no scale path, so only "pallas"
+            # falls back here.
             log.info(
-                "kv_quantize=%s: forcing xla paged attention (was %s)",
+                "kv_quantize=%s: forcing xla paged attention (was %s; "
+                "grid kernel has no scale path — pallas-dma streams int8 "
+                "pages natively)",
                 cfg.kv_quantize, self.attn_impl,
             )
             self.attn_impl = "xla"
+        from ..ops.attention import pallas_interpret
+
         if (
             self.attn_impl == "pallas-dma"
             and self.model_cfg.head_dim_ % 128 != 0
+            and not pallas_interpret()
         ):
             # Mosaic requires manual-DMA memref slices to be 128-aligned
             # on the minormost dim (measured on-chip r04: bench-1b's
             # head_dim=64 fails to compile with "Slice shape along
-            # dimension 3 must be aligned to tiling (128)").
+            # dimension 3 must be aligned to tiling (128)"). Interpret
+            # mode (the CPU sweep smoke) has no Mosaic, so the gate only
+            # applies to compiled runs.
             log.info(
                 "pallas-dma needs head_dim %% 128 == 0 (got %d): "
                 "falling back to xla paged attention",
@@ -782,6 +793,14 @@ class Engine:
         "bench-spec": frozenset(
             {"prefill", "sample", "decode_greedy", "spec"}
         ),
+        # The ragged-backend sweep drives the engine through sync
+        # step_mixed only (admission chunks AND decode ticks both ride
+        # the mixed program), so it needs exactly the mixed family — one
+        # compile per mixed bucket, tracing through the RESOLVED
+        # attn_impl, which is how each sweep cell's kernel gets compiled
+        # before the timed window. Paying for the prefill/decode-block
+        # cross-product per sweep cell would blow the stage budget.
+        "bench-mixed": frozenset({"mixed"}),
         # "fsm" rides along: sessions workloads carry schema-constrained
         # rows since the grammar fast-forward bench, and a constrained
         # row's first block dispatch must not compile under load.
@@ -813,6 +832,19 @@ class Engine:
                 yield
         finally:
             self._mesh_tls.active = False
+
+    def impl_info(self) -> dict[str, str]:
+        """The RESOLVED execution modes: attention impl after every
+        fallback gate (MLA, kv-quantize, head-dim alignment) plus weight
+        and KV quantization. Folded into ``/healthz`` and every bench
+        result line's ``extra`` so sweep rows and fleet snapshots are
+        self-describing — the env knob records what was ASKED for, this
+        records what actually runs."""
+        return {
+            "attn_impl": self.attn_impl,
+            "quantize": self.cfg.quantize or "none",
+            "kv_quantize": self.cfg.kv_quantize or "none",
+        }
 
     def warmup(self, level: str = "full") -> float:
         """Compile serving programs ahead of the first request: each

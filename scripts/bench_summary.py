@@ -161,6 +161,25 @@ def terminal_summary(paths: list[str]) -> int:
             print(f"kernel verdict: pallas-dma best "
                   f"{max(d['value'] for d in dma):.0f} vs xla best "
                   f"{max(d['value'] for d in xla):.0f}")
+    # Ragged-backend sweep (the MIXED hot path): best cell per RESOLVED
+    # impl, with the byte-identical verdict — the decision input for
+    # flipping paged_attention_backend()'s default.
+    sweep = [d for d in rows
+             if d["metric"].startswith("mixed_ragged_throughput")
+             and "best_cell" not in d.get("extra", {})]
+    if sweep:
+        by_impl: dict[str, float] = {}
+        for d in sweep:
+            impl = d.get("extra", {}).get("attn_impl", "?")
+            by_impl[impl] = max(by_impl.get(impl, 0.0), d["value"])
+        ident = all(
+            d.get("extra", {}).get("outputs_identical") for d in sweep
+        )
+        print("mixed-ragged sweep: "
+              + "; ".join(f"{k} best {v:.0f}"
+                          for k, v in sorted(by_impl.items()))
+              + f" tok/s/chip over {len(sweep)} cells; outputs "
+              f"identical: {ident}")
     sess = [d for d in tpu if "concurrent_sessions" in d["metric"]]
     if sess:
         # Best (lowest-TTFT) row, not positionally last: multiple files

@@ -435,3 +435,52 @@ def test_fleet_chaos_mode_zero_failed_requests_under_faults():
     assert e["outputs_identical"] is True
     # Both phases measured the containment cost.
     assert e["p99_ttft_ms"] > 0 and e["off_p99_ttft_ms"] > 0
+
+
+def test_ragged_sweep_mode_emits_per_backend_identical_rows():
+    """OPSAGENT_BENCH_MODE=ragged-sweep (the mixed-hot-path backend
+    sweep) on CPU must run every (backend x KV dtype) cell through
+    interpret-mode Pallas, emit one tok/s/chip row per cell with the
+    RESOLVED impl in extra, verify byte-identical greedy output against
+    each group's xla cell, and end with the best-cell summary line."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "ragged-sweep",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "2",
+        "OPSAGENT_BENCH_STEPS": "8",
+        "OPSAGENT_BENCH_PROMPT": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    rows = []
+    for ln in out.stdout.splitlines():
+        try:
+            parsed = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            rows.append(parsed)
+    # 3 backends x 2 KV dtypes (weight quant stays off-chip) + summary.
+    assert len(rows) == 7, [r["metric"] for r in rows]
+    cells = rows[:-1]
+    for r in cells:
+        assert r["unit"] == "tok/s/chip"
+        e = r["extra"]
+        assert e["outputs_identical"] is True, r["metric"]
+        assert e["post_warmup_compiles"] == 0, r["metric"]
+        assert e["interpret"] is True
+        # Self-describing: resolved impl + quant modes ride every row.
+        assert e["attn_impl"] in ("xla", "pallas", "pallas-dma")
+        assert e["kv_quantize"] in ("none", "int8")
+    resolved = {(e["requested_backend"], e["kv_quantize"]): e["attn_impl"]
+                for e in (r["extra"] for r in cells)}
+    # pallas-dma streams int8 pages natively; the grid kernel has no
+    # scale path so its int8 cell resolves to the xla gather.
+    assert resolved[("pallas-dma", "int8")] == "pallas-dma"
+    assert resolved[("pallas", "int8")] == "xla"
+    assert resolved[("pallas", "none")] == "pallas"
+    # Summary last: best cell's value with the per-cell map folded in.
+    summary = rows[-1]
+    assert summary["extra"]["cells"] == 6
+    assert summary["value"] == max(r["value"] for r in cells)
+    assert len(summary["extra"]["cell_tok_s_chip"]) == 6

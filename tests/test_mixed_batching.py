@@ -278,3 +278,46 @@ def test_mixed_dispatch_composition_metrics_recorded():
     )
     _drain_all(eng, [a, b])
     eng.finish(a), eng.finish(b)
+
+
+def test_mixed_backends_byte_identical_with_int8_kv(monkeypatch):
+    """step_mixed with kv_quantize="int8" across attention backends
+    (xla gather vs the ragged manual-DMA kernel, interpret off-chip):
+    chunked admission + interleaved decode lanes must produce
+    byte-identical greedy output, the resolved impl must be the
+    requested backend (the old QuantizedPages fallback forced xla), and
+    no mixed composition may compile post-warmup."""
+    prompts = [
+        [257] + list(range(1, 12)),
+        [257] + [5, 9, 2, 8, 1, 7, 3, 3, 4, 6, 2, 9, 8, 1, 5, 5, 2],
+        [257, 4, 4, 2],
+    ]
+    monkeypatch.setenv("OPSAGENT_PALLAS_INTERPRET", "1")
+    outs = {}
+    for backend in ("xla", "pallas-dma"):
+        monkeypatch.setenv("OPSAGENT_PAGED_BACKEND", backend)
+        cfg = EngineConfig(
+            mixed_batching=True, kv_quantize="int8", **BASE
+        )
+        eng = Engine(cfg)
+        assert eng.attn_impl == backend
+        eng.warmup("sessions")
+        sampling = SamplingParams(max_tokens=8)
+        n0 = len(_COMPILES)
+        sids: list[int] = []
+        for prompt in prompts:
+            b = eng.begin_request(prompt, sampling)
+            while b in eng._prefilling:
+                done, total = eng.prefill_progress(b)
+                lanes = [s for s in sids if not eng.sequences[s].done][:2]
+                eng.step_mixed(lanes, {b: min(total - done, 16)})
+            sids.append(b)
+        live = [s for s in sids if not eng.sequences[s].done]
+        while live:
+            eng.step_mixed(live, {})
+            live = [s for s in live if not eng.sequences[s].done]
+        outs[backend] = [eng.finish(s) for s in sids]
+        assert len(_COMPILES) == n0, (
+            f"{len(_COMPILES) - n0} post-warmup compiles on {backend}"
+        )
+    assert outs["xla"] == outs["pallas-dma"], outs

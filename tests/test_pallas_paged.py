@@ -412,3 +412,263 @@ def test_ragged_decode_row_matches_decode_kernel_semantics():
     np.testing.assert_allclose(
         np.asarray(ragged)[:, 0], np.asarray(dec), rtol=2e-5, atol=2e-5
     )
+
+
+# -- ragged manual-DMA kernel (the mixed hot path's bytes-diet form) ---------
+@pytest.mark.parametrize(
+    "B,S,H,K,D,P,MaxP,start,q_lens",
+    [
+        # decode row (q_len=1) + prefill chunk + inactive row in one batch
+        (3, 8, 4, 2, 32, 4, 8, [9, 4, 0], [1, 6, 0]),
+        # fresh prompt chunk from position 0, full S
+        (2, 8, 4, 4, 16, 8, 4, [0, 0], [8, 3]),
+        # chunk crossing page boundaries with a long cached prefix
+        (2, 4, 8, 2, 32, 4, 10, [13, 30], [4, 2]),
+        # all-decode tick (the steady-state mixed shape) + inactive rows
+        (4, 4, 4, 2, 16, 4, 6, [7, 3, 0, 15], [1, 1, 0, 1]),
+    ],
+)
+def test_ragged_dma_matches_xla_reference(
+    B, S, H, K, D, P, MaxP, start, q_lens
+):
+    from opsagent_tpu.ops.attention import paged_ragged_attention
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(21)
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B, S, H, K, D, P, MaxP, num_pages=B * MaxP + 2,
+        start=start, q_lens=q_lens,
+    )
+    ref = paged_ragged_attention(q, k_pages, v_pages, table, st, ql)
+    got = paged_ragged_attention_pallas_dma(
+        q, k_pages, v_pages, table, st, ql, interpret=True
+    )
+    for b in range(B):
+        n = q_lens[b]
+        if n:
+            np.testing.assert_allclose(
+                np.asarray(got)[b, :n], np.asarray(ref)[b, :n],
+                rtol=2e-5, atol=2e-5,
+            )
+        else:
+            # q_len=0 rows stream ZERO pages (n=0 warmup skip) and must
+            # come out exactly zero, not garbage.
+            assert (np.asarray(got)[b] == 0).all()
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_ragged_dma_bf16_tolerance():
+    from opsagent_tpu.ops.attention import paged_ragged_attention
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(22)
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B=2, S=8, H=4, K=2, D=32, P=4, MaxP=8,
+        num_pages=18, start=[9, 0], q_lens=[1, 8],
+    )
+    q = q.astype(jnp.bfloat16)
+    k_pages = k_pages.astype(jnp.bfloat16)
+    v_pages = v_pages.astype(jnp.bfloat16)
+    ref = paged_ragged_attention(q, k_pages, v_pages, table, st, ql)
+    got = paged_ragged_attention_pallas_dma(
+        q, k_pages, v_pages, table, st, ql, interpret=True
+    )
+    for b, n in enumerate([1, 8]):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32)[b, :n],
+            np.asarray(ref, np.float32)[b, :n],
+            rtol=3e-2, atol=3e-2,
+        )
+
+
+def test_ragged_dma_quantized_matches_xla_reader():
+    """int8 QuantizedPages through the ragged DMA kernel (interpret) must
+    match the XLA ragged gather on the SAME quantized cache — identical
+    dequantize math, pages never materialized full-dtype."""
+    from opsagent_tpu.ops.attention import (
+        QuantizedPages, paged_ragged_attention, write_kv_pages,
+    )
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(23)
+    B, S, H, K, D, P, MaxP, N = 3, 8, 4, 2, 32, 4, 8, 26
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B, S, H, K, D, P, MaxP, num_pages=N,
+        start=[9, 0, 4], q_lens=[1, 8, 0],
+    )
+    kq = QuantizedPages(
+        jnp.zeros((N, P, K, D), jnp.int8), jnp.ones((N, P, K), jnp.float32)
+    )
+    vq = QuantizedPages(
+        jnp.zeros((N, P, K, D), jnp.int8), jnp.ones((N, P, K), jnp.float32)
+    )
+    # Fill each row's resident KV (cached prefix + chunk) through the
+    # real write path so scales are per-token absmax, like the engine.
+    total = int(max(s + l for s, l in zip([9, 0, 4], [1, 8, 0])))
+    kw = jnp.asarray(rng.standard_normal((B, total, K, D)), jnp.float32)
+    vw = jnp.asarray(rng.standard_normal((B, total, K, D)), jnp.float32)
+    kq, vq = write_kv_pages(
+        kq, vq, kw, vw, table, jnp.zeros((B,), jnp.int32),
+        valid_len=st + ql,
+    )
+    ref = paged_ragged_attention(q, kq, vq, table, st, ql)
+    got = paged_ragged_attention_pallas_dma(
+        q, kq, vq, table, st, ql, interpret=True
+    )
+    for b, n in enumerate([1, 8, 0]):
+        if n:
+            np.testing.assert_allclose(
+                np.asarray(got)[b, :n], np.asarray(ref)[b, :n],
+                rtol=2e-5, atol=2e-5,
+            )
+
+
+def test_ragged_dma_layer_form():
+    """Whole-cache [L, N, P, K, D] + layer offset on the ragged DMA
+    kernel selects the right layer's pages."""
+    from opsagent_tpu.ops.attention import paged_ragged_attention
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(24)
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B=2, S=4, H=4, K=2, D=32, P=4, MaxP=6,
+        num_pages=14, start=[9, 0], q_lens=[1, 4],
+    )
+    L = 3
+    k_l = jnp.stack([
+        jnp.asarray(rng.standard_normal(k_pages.shape), jnp.float32)
+        for _ in range(L)
+    ])
+    v_l = jnp.stack([
+        jnp.asarray(rng.standard_normal(v_pages.shape), jnp.float32)
+        for _ in range(L)
+    ])
+    for layer in (0, 2):
+        ref = paged_ragged_attention(
+            q, k_l[layer], v_l[layer], table, st, ql
+        )
+        got = paged_ragged_attention_pallas_dma(
+            q, k_l, v_l, table, st, ql,
+            interpret=True, layer=jnp.int32(layer),
+        )
+        for b, n in enumerate([1, 4]):
+            np.testing.assert_allclose(
+                np.asarray(got)[b, :n], np.asarray(ref)[b, :n],
+                rtol=2e-5, atol=2e-5,
+            )
+
+
+def test_ragged_dma_under_tp_matches_oracle():
+    """The ragged DMA kernel under tensor parallelism (impl dispatch in
+    the shared TP wrapper): tp=2 mesh, q + kv heads sharded, no
+    collective — must reproduce the unsharded XLA ragged oracle."""
+    from opsagent_tpu.ops.attention import (
+        paged_ragged_attention, paged_ragged_attention_pallas_tp,
+    )
+    from opsagent_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tp=2, dp=1, sp=1, devices=jax.devices()[:2])
+    rng = np.random.default_rng(25)
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B=2, S=8, H=4, K=2, D=32, P=4, MaxP=8,
+        num_pages=18, start=[9, 0], q_lens=[1, 8],
+    )
+    ref = paged_ragged_attention(q, k_pages, v_pages, table, st, ql)
+    got = paged_ragged_attention_pallas_tp(
+        q, k_pages, v_pages, table, st, ql, mesh,
+        interpret=True, impl="pallas-dma",
+    )
+    for b, n in enumerate([1, 8]):
+        np.testing.assert_allclose(
+            np.asarray(got)[b, :n], np.asarray(ref)[b, :n],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_ragged_dma_rejects_unaligned_head_dim():
+    """Compiled mode refuses head_dim % 128 != 0 up front (the same
+    Mosaic manual-DMA alignment rule as the decode kernel)."""
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(26)
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B=1, S=4, H=4, K=2, D=64, P=4, MaxP=2,
+        num_pages=4, start=[0], q_lens=[4],
+    )
+    with pytest.raises(ValueError, match="head_dim"):
+        paged_ragged_attention_pallas_dma(
+            q, k_pages, v_pages, table, st, ql, interpret=False
+        )
+
+
+def test_ragged_dma_length_beyond_table_clamps():
+    """start + q_len claiming more pages than the table holds must clamp
+    to resident pages (like the decode kernel) — no OOB table read, no
+    leaked prefetch DMA, no NaN."""
+    from opsagent_tpu.ops.attention import paged_ragged_attention
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(27)
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B=2, S=4, H=4, K=2, D=32, P=4, MaxP=3,
+        num_pages=8, start=[11, 11], q_lens=[1, 1],
+    )
+    over = jnp.asarray([11, 27], jnp.int32)  # row 1 claims 7 pages of 3
+    ref = paged_ragged_attention(q, k_pages, v_pages, table, st, ql)
+    got = paged_ragged_attention_pallas_dma(
+        q, k_pages, v_pages, table, over, ql, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[0, :1], np.asarray(ref)[0, :1], rtol=2e-5, atol=2e-5
+    )
+    assert not np.isnan(np.asarray(got)).any()
+
+
+@pytest.mark.slow
+def test_ragged_dma_at_bench_8b_mixed_shape():
+    """Interpret parity at the EXACT bench-8b mixed decode-tick shape
+    (B=32, S=4 bucket, H=32, K=8, D=128, P=64, bf16): all-decode rows at
+    ragged positions plus one admitting chunk row — the sweep stage's
+    steady-state dispatch, validated before burning chip time."""
+    from opsagent_tpu.ops.attention import paged_ragged_attention
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas_dma,
+    )
+
+    rng = np.random.default_rng(28)
+    B, S, H, K, D, P, MaxP = 32, 4, 32, 8, 128, 64, 12
+    start = [int(rng.integers(0, MaxP * P - S)) for _ in range(B)]
+    q_lens = [1] * B
+    q_lens[-1] = S  # one admitting chunk row rides along
+    q_lens[5] = 0   # and one inactive slot
+    q, k_pages, v_pages, table, st, ql = _make_ragged_case(
+        rng, B, S, H, K, D, P, MaxP, num_pages=B * MaxP + 2,
+        start=start, q_lens=q_lens,
+    )
+    q = q.astype(jnp.bfloat16)
+    k_pages = k_pages.astype(jnp.bfloat16)
+    v_pages = v_pages.astype(jnp.bfloat16)
+    ref = paged_ragged_attention(q, k_pages, v_pages, table, st, ql)
+    got = paged_ragged_attention_pallas_dma(
+        q, k_pages, v_pages, table, st, ql, interpret=True
+    )
+    for b in range(B):
+        n = q_lens[b]
+        if n:
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32)[b, :n],
+                np.asarray(ref, np.float32)[b, :n],
+                rtol=3e-2, atol=3e-2,
+            )

@@ -217,3 +217,26 @@ def test_bench_perf_gate_flag(monkeypatch):
     bench.exit_if_perf_regression([
         _row("paged_decode_throughput[tiny-test,B=4,cpu]", 33.0)
     ])
+
+
+def test_ragged_sweep_rows_gate_higher_better(tmp_path):
+    """The ragged-sweep cells report tok/s/chip and must gate in the
+    higher-is-better direction: a dropped kernel-cell value fails, a
+    faster one passes, and a brand-new cell (no baseline twin) never
+    gates."""
+    from opsagent_tpu.cli.perfcheck import _higher_better
+
+    assert _higher_better("tok/s/chip") is True
+    cell = ("mixed_ragged_throughput[bench-8b,int8,kv-int8,pallas-dma,"
+            "B=32,tpu]")
+    base = _jsonl(tmp_path / "base.jsonl", BASELINE + [_row(cell, 2400.0)])
+    slower = _jsonl(tmp_path / "cur.jsonl", [_row(cell, 2400.0 * 0.7)])
+    assert run_perf_check(slower, baseline=base) == 1
+    faster = _jsonl(tmp_path / "cur2.jsonl", [_row(cell, 2400.0 * 1.3)])
+    assert run_perf_check(faster, baseline=base) == 0
+    fresh = _jsonl(tmp_path / "cur3.jsonl", [
+        _row("mixed_ragged_throughput[bench-8b,int4,kv-int8,pallas-dma,"
+             "B=32,tpu]", 2800.0),
+        _row("paged_decode_throughput[bench-8b,int8,B=32,tpu]", 1899.0),
+    ])
+    assert run_perf_check(fresh, baseline=base) == 0
