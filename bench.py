@@ -67,6 +67,11 @@ seeded faults off, then on (serving/faults: mid-SSE disconnects at
 fixed hit counts) — reporting failed requests (must stay 0: router
 failover absorbs the deaths), failovers, shed count, and the p99 TTFT
 delta containment costs, in one JSON line.
+OPSAGENT_BENCH_MODE=fleet-journey runs the streamed fleet workload with
+request journeys on vs off (the obs-overhead A/B) plus one stitched-
+timeline smoke: a request forced through mid-SSE failover + peer
+fault-in must yield ONE router timeline with lanes from both replicas,
+failover/fault_in windows, >= 95% coverage, monotonic segments.
 ``--perf-gate`` (or OPSAGENT_BENCH_PERF_GATE=1) compares the
 orchestrated run's result lines against the committed
 BENCH_r*_local.jsonl baseline after the headline is printed and exits 4
@@ -541,6 +546,17 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         240, "fleet-global-kv",
     ) if on_tpu else None
+    # Fleet-journey obs-overhead A/B + stitched-timeline smoke: request
+    # journeys (ID stamping + participants map + hop metrics) on vs off
+    # on the streamed fleet workload, plus one forced failover+fault-in
+    # request whose router timeline must stitch lanes from BOTH replicas
+    # at >= 95% coverage. The reported value is the overhead percent
+    # cross-replica tracing costs the request plane.
+    rjourney = stage(
+        {"OPSAGENT_BENCH_MODE": "fleet-journey",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        230, "fleet-journey",
+    ) if on_tpu else None
     # The literal north-star metric (BASELINE: p50 TTFT per tool-call
     # turn): multi-turn ReAct-shaped sessions with the prefix cache on.
     # Reports ms, not tok/s — never a headline candidate; folded into
@@ -714,6 +730,13 @@ def run_orchestrated() -> None:
         extra["fleet_chaos_outputs_identical"] = che.get(
             "outputs_identical"
         )
+    if rjourney is not None:
+        je = rjourney.get("extra", {})
+        extra["fleet_journey_overhead_pct"] = rjourney["value"]
+        extra["fleet_journey_on_tok_s"] = je.get("journeys_on_tok_s")
+        extra["fleet_journey_off_tok_s"] = je.get("journeys_off_tok_s")
+        extra["fleet_journey_smoke_ok"] = je.get("smoke_ok")
+        extra["fleet_journey_smoke_coverage"] = je.get("smoke_coverage")
     if rfgkv is not None:
         ge = rfgkv.get("extra", {})
         extra["fleet_global_kv_remote_hit_pages"] = ge.get(
@@ -850,7 +873,8 @@ def run_single() -> None:
         return
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "sessions-ffwd", "fleet-affinity",
-                "fleet-chaos", "fleet-global-kv", "cold-start"):
+                "fleet-chaos", "fleet-global-kv", "fleet-journey",
+                "cold-start"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -925,7 +949,8 @@ def run_single() -> None:
         mixed_batching=mixed_on,
         async_depth=async_depth,
         offload=(mode in ("sessions-offload", "fleet-affinity",
-                          "fleet-chaos", "fleet-global-kv")),
+                          "fleet-chaos", "fleet-global-kv",
+                          "fleet-journey")),
     )
     # Fail fast on undersized sweep points: OutOfPages mid-window would
     # force-finish sequences ('length') and quietly deflate the metric.
@@ -965,7 +990,7 @@ def run_single() -> None:
     t0 = time.perf_counter()
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "sessions-ffwd", "fleet-affinity",
-                "fleet-chaos", "fleet-global-kv"):
+                "fleet-chaos", "fleet-global-kv", "fleet-journey"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -1006,6 +1031,10 @@ def run_single() -> None:
     if mode == "fleet-global-kv":
         run_fleet_global_kv(eng, cfg, model, batch, steps, prompt_len,
                             platform, n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "fleet-journey":
+        run_fleet_journey(eng, cfg, model, batch, steps, prompt_len,
+                          platform, n_chips, quantize, init_s, warmup_s)
         return
     if mode == "agent":
         # turns/gen_tokens are THE values the page-budget guard above was
@@ -2475,6 +2504,220 @@ def run_fleet_chaos(eng, cfg, model, batch, steps, prompt_len, platform,
     log_perf_table()
     for s in stacks:
         s.close()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_fleet_journey(eng, cfg, model, batch, steps, prompt_len, platform,
+                      n_chips, quantize, init_s, warmup_s) -> None:
+    """The fleet-journey observability stage (ISSUE 16): two in-process
+    replicas behind the FleetRouter. Two parts. (1) Obs-overhead A/B:
+    the concurrent streamed sessions workload with journeys ON then OFF
+    (no ID stamping, no participants map) — the reported delta is what
+    cross-replica tracing costs on the request plane. (2) Stitched-
+    timeline smoke: one request forced through a mid-SSE failover plus a
+    pagestore peer fault-in must come back from the router as ONE
+    stitched timeline with segment lanes from BOTH replicas, failover +
+    fault_in windows, >= 95% coverage, and monotonic non-overlapping
+    segments after skew correction — with byte-identical greedy text."""
+    import threading
+    from dataclasses import replace as dc_replace
+
+    from opsagent_tpu.serving import faults
+    from opsagent_tpu.serving.api import ServingStack
+    from opsagent_tpu.serving.engine import Engine
+    from opsagent_tpu.serving.fleet.router import FleetRouter
+
+    gen_tokens = max(16, steps // 8)
+    e2 = Engine(dc_replace(cfg, seed=cfg.seed))
+    e2.warmup("sessions")
+    stacks = [ServingStack(eng), ServingStack(e2)]
+
+    def drive(router, seed_base: int) -> dict:
+        chunks_total = [0]
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def session(sid: int) -> None:
+            rng = np.random.default_rng(seed_base + sid)
+            words = [
+                f"w{rng.integers(0, 9999)}" for _ in range(prompt_len // 2)
+            ]
+            n = 0
+            try:
+                for ch in router.complete_stream({
+                    "messages": [
+                        {"role": "system", "content": "journey bench"},
+                        {"role": "user", "content": " ".join(words)},
+                    ],
+                    "max_tokens": gen_tokens, "temperature": 0.0,
+                    "stream": True,
+                }):
+                    if "error" in ch:
+                        raise RuntimeError(ch["error"]["message"])
+                    if ch["choices"][0]["delta"].get("content"):
+                        n += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"session {sid}: {e}")
+                return
+            with lock:
+                chunks_total[0] += n
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=session, args=(i,))
+            for i in range(batch)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {
+            "wall": wall, "errors": errors,
+            "tok_s": chunks_total[0] / wall if wall > 0 else 0.0,
+        }
+
+    # (1) Obs-overhead A/B — distinct prompt seeds per phase so both
+    # phases prefill cold (neither inherits the other's prefix cache).
+    # A discarded warmup pass absorbs first-drive lazy-init costs
+    # (thread spin-up, tokenizer caches) that would otherwise be billed
+    # entirely to whichever phase runs first.
+    warm_router = FleetRouter()
+    for i, stack in enumerate(stacks):
+        warm_router.add_local(stack, f"jr{i}")
+    drive(warm_router, seed_base=30000)
+    phases: dict[str, dict] = {}
+    for tag, journeys, seed_base in (
+        ("on", True, 31000), ("off", False, 32000),
+    ):
+        router = FleetRouter(journeys=journeys)
+        for i, stack in enumerate(stacks):
+            router.add_local(stack, f"jr{i}")
+        phases[tag] = drive(router, seed_base=seed_base)
+        r = phases[tag]
+        log(f"bench[fleet-journey/{tag}]: {batch} streamed sessions in "
+            f"{r['wall']:.2f}s ({r['tok_s']:.1f} chunk/s) "
+            f"errors={len(r['errors'])}")
+    on, off = phases["on"], phases["off"]
+    overhead_pct = (
+        (off["tok_s"] - on["tok_s"]) / off["tok_s"] * 100.0
+        if off["tok_s"] > 0 else 0.0
+    )
+
+    # (2) Stitched-timeline smoke: failover + peer fault-in in ONE
+    # journey, stitched from both replicas through the router.
+    router = FleetRouter()   # journeys + pagestore directory on
+    for i, stack in enumerate(stacks):
+        router.add_local(stack, f"jr{i}")
+    # Each turn must SEAL full KV pages (page_size is 64 at bench
+    # geometry) or the directory has nothing for jr1 to fault in — size
+    # both user turns at a few pages' worth of tokens, and generate
+    # across multiple decode blocks so the injected disconnect lands
+    # mid-flight. The failover push (migrate_chain) ships the chain
+    # ahead of the resume; transfer.truncate@1 drops its first record
+    # in transit, so the resuming replica's admission must repair the
+    # hole through the page directory — a true peer fault-in on the
+    # SAME journey as the failover.
+    nfill = max(24, cfg.page_size // 2)
+    filler = " ".join(f"ctx{i}" for i in range(nfill))
+    filler2 = " ".join(f"doc{i}" for i in range(nfill))
+    gen2 = max(32, cfg.decode_block * 2)
+    messages = [
+        {"role": "system", "content": "journey smoke"},
+        {"role": "user", "content": f"first turn here {filler}"},
+    ]
+    r1 = router.complete(
+        {"messages": messages, "max_tokens": 8, "temperature": 0},
+        force_replica="jr0",
+    )
+    turn2 = list(messages) + [
+        {"role": "assistant",
+         "content": r1["choices"][0]["message"]["content"] or ""},
+        {"role": "user", "content": f"second turn now {filler2}"},
+    ]
+    faults.configure("fleet.stream_disconnect@5;transfer.truncate@1")
+    chunks = list(router.complete_stream({
+        "messages": turn2, "max_tokens": gen2, "temperature": 0,
+        "stream": True,
+    }))
+    faults.reset()
+    text = "".join(
+        c["choices"][0]["delta"].get("content") or "" for c in chunks
+    )
+    # Reference is a fault-free STREAM (forced jr0), computed AFTER the
+    # faulted run so it cannot pre-park the turn-2 chain on jr0: the
+    # seam comparison is stream-vs-stream — the non-stream body can
+    # legitimately differ in how a trailing incomplete UTF-8 sequence
+    # renders at EOS.
+    want = "".join(
+        c["choices"][0]["delta"].get("content") or ""
+        for c in router.complete_stream(
+            {"messages": turn2, "max_tokens": gen2, "temperature": 0,
+             "stream": True},
+            force_replica="jr0",
+        )
+    )
+    jid = chunks[0].get("id", "")
+    tl = router.timeline(jid) or {}
+    seg_lanes = {s["replica"] for s in tl.get("segments", [])}
+    win_kinds = {w["kind"] for w in tl.get("windows", [])}
+    monotonic = all(
+        cur["start_ms"] >= prev["end_ms"] - 1e-6
+        for prev, cur in zip(tl.get("segments", []),
+                             tl.get("segments", [])[1:])
+    )
+    smoke_ok = (
+        text == want
+        and tl.get("fleet") is True
+        and len(seg_lanes) >= 2
+        and "failover" in win_kinds
+        and "fault_in" in win_kinds
+        and tl.get("coverage", 0.0) >= 0.95
+        and monotonic
+    )
+    log(f"bench[fleet-journey/smoke]: shape={tl.get('shape')} "
+        f"lanes={sorted(seg_lanes)} windows={sorted(win_kinds)} "
+        f"coverage={tl.get('coverage', 0.0):.3f} monotonic={monotonic} "
+        f"identical={text == want} ok={smoke_ok}")
+    if not smoke_ok:
+        log(f"bench[fleet-journey/smoke]: FAILED timeline={tl}")
+
+    snap = metrics_snapshot()
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": f"fleet_journey[{model}{qtag},N={batch},{platform}]",
+        "value": round(overhead_pct, 2),
+        "unit": "overhead_pct",
+        "vs_baseline": None,
+        "extra": {
+            "sessions": batch,
+            "journeys_on_tok_s": round(on["tok_s"], 2),
+            "journeys_off_tok_s": round(off["tok_s"], 2),
+            "on_errors": len(on["errors"]),
+            "off_errors": len(off["errors"]),
+            "smoke_ok": smoke_ok,
+            "smoke_shape": tl.get("shape"),
+            "smoke_replica_lanes": sorted(seg_lanes),
+            "smoke_windows": sorted(win_kinds),
+            "smoke_coverage": tl.get("coverage", 0.0),
+            "smoke_monotonic": monotonic,
+            "smoke_identical": text == want,
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
+            "metrics": snap,
+            "attribution": attribution_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    log_perf_table()
+    for s in stacks:
+        s.close()
+    if not smoke_ok:
+        raise SystemExit("bench: fleet-journey stitched-timeline smoke "
+                         "failed (see log above)")
     exit_if_slo_breach(slo_verdicts())
 
 

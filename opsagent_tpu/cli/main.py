@@ -165,6 +165,30 @@ def build_parser() -> argparse.ArgumentParser:
         help="print the raw directory JSON instead of the table",
     )
 
+    ffl = sub.add_parser(
+        "fleet-flight",
+        help="dump the fleet flight ledger: every replica's flight "
+             "ring merged into one replica-tagged, skew-corrected, "
+             "time-ordered event stream",
+    )
+    ffl.add_argument(
+        "--url", default="http://127.0.0.1:8090",
+        help="fleet router base URL; fetches GET /api/fleet/flight",
+    )
+    ffl.add_argument(
+        "--n", type=int, default=64,
+        help="merged event tail length (0 = everything in the rings)",
+    )
+    ffl.add_argument("--kind", default="", help="filter by event kind")
+    ffl.add_argument(
+        "--request-id", default="",
+        help="filter to one journey's events (implies no tail cap)",
+    )
+    ffl.add_argument(
+        "--json", action="store_true", default=False,
+        help="print the raw ledger JSON instead of the table",
+    )
+
     se = sub.add_parser("serve-engine", help="run the TPU serving engine (OpenAI-compatible)")
     se.add_argument("--port", type=int, default=8000)
     se.add_argument("--host", default="0.0.0.0")
@@ -491,6 +515,12 @@ def main(argv: list[str] | None = None) -> int:
                 return 1
         if args.json:
             print(_json.dumps(tl_data, indent=2))
+        elif isinstance(tl_data, dict) and tl_data.get("fleet"):
+            # Fleet-scope stitched timeline (router): multi-lane gantt
+            # with one row per replica plus the router-side windows.
+            print(obs_timeline.render_fleet_gantt(
+                tl_data, width=args.width
+            ))
         else:
             print(obs_timeline.render_gantt(tl_data, width=args.width))
         return 0
@@ -548,6 +578,50 @@ def main(argv: list[str] | None = None) -> int:
             if snap.get("truncated"):
                 print(f"... truncated at {len(rows)} rows "
                       f"(raise --limit for more)")
+        return 0
+
+    if args.command == "fleet-flight":
+        import json as _json
+        import urllib.request
+        from urllib.parse import quote
+
+        url = (
+            args.url.rstrip("/")
+            + f"/api/fleet/flight?n={args.n}"
+            + (f"&kind={quote(args.kind)}" if args.kind else "")
+            + (
+                f"&request_id={quote(args.request_id)}"
+                if args.request_id else ""
+            )
+        )
+        try:
+            with urllib.request.urlopen(  # noqa: S310 - operator URL
+                url, timeout=15
+            ) as resp:
+                ledger = _json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 - CLI surface
+            print(f"fleet flight fetch failed: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(_json.dumps(ledger, indent=2))
+            return 0
+        offsets = ledger.get("clock_offset_s", {})
+        if offsets:
+            print("clock offsets: " + ", ".join(
+                f"{r}={o * 1e3:+.1f}ms" for r, o in sorted(offsets.items())
+            ))
+        events = ledger.get("events", [])
+        print(f"{len(events)} events from "
+              f"{len(ledger.get('replicas', []))} replicas\n")
+        for e in events:
+            wall = e.get("wall_corrected", e.get("wall", 0.0))
+            extras = " ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("kind", "source", "replica", "wall",
+                             "wall_corrected", "ts", "id")
+            )
+            print(f"{wall:>17.6f} {e.get('source', '?'):<10} "
+                  f"{e.get('kind', '?'):<18} {extras}")
         return 0
 
     if args.command == "server":

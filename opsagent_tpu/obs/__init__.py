@@ -343,6 +343,35 @@ FLEET_KV_IMPORT_REJECTS = _reg.counter(
     "structure mismatch); the receiver re-prefills instead",
 )
 
+# -- fleet request journeys: cross-replica trace propagation ------------------
+FLEET_HOP_SECONDS = _reg.histogram(
+    "opsagent_fleet_hop_seconds",
+    "Wall time of one replica hop of a routed request, by hop kind "
+    "(route = non-streaming completion, stream = streaming completion, "
+    "failover = mid-SSE resume on a survivor, hedge = TTFT hedge probe, "
+    "prefill = disaggregated prefill handoff, fault_in = pagestore peer "
+    "fetch, migrate = session KV migration)",
+    labelnames=("hop",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0),
+)
+FLEET_JOURNEYS = _reg.counter(
+    "opsagent_fleet_journeys_total",
+    "Completed fleet request journeys by shape (direct = one replica "
+    "start to finish, retried = connect-phase re-route, hedged = a "
+    "backup probe raced, failover = resumed on a survivor mid-request; "
+    "a journey counts once under its most eventful shape)",
+    labelnames=("shape",),
+)
+FLEET_CLOCK_SKEW = _reg.gauge(
+    "opsagent_fleet_clock_skew_seconds",
+    "EWMA estimate of a replica's wall clock minus the router's wall "
+    "clock, from heartbeat timestamp echoes (the offset the fleet "
+    "timeline stitcher subtracts before ordering cross-replica "
+    "segments)",
+    labelnames=("replica",),
+)
+
 # -- fleet-global KV: page directory + peer-to-peer fault-in ------------------
 PAGESTORE_LOOKUPS = _reg.counter(
     "opsagent_pagestore_lookups_total",

@@ -214,6 +214,20 @@ class FlightRecorder:
                     out.append({"kind": "timeline", **tl})
             except Exception:  # noqa: BLE001
                 pass
+            if _journey_provider is not None:
+                # Fleet context: when a router lives in this process its
+                # participants map knows which replicas served this
+                # request and through which hops — the cross-replica
+                # journey of the triggering request rides in the dump.
+                try:
+                    j = _journey_provider(rid)
+                    if j:
+                        out.append({
+                            "kind": "fleet_journey", "request_id": rid,
+                            **j,
+                        })
+                except Exception:  # noqa: BLE001
+                    pass
         return out
 
     # -- reading -----------------------------------------------------------
@@ -252,6 +266,16 @@ class FlightRecorder:
 
 _recorder: FlightRecorder | None = None
 _recorder_lock = threading.Lock()
+
+# Optional fleet-journey lookup (request_id -> journey dict or None).
+# A FleetRouter in this process registers its participants map here so
+# anomaly dumps carry the cross-replica story of the triggering request.
+_journey_provider: Any = None
+
+
+def set_journey_provider(fn: Any) -> None:
+    global _journey_provider
+    _journey_provider = fn
 
 
 def get_recorder() -> FlightRecorder:

@@ -224,6 +224,13 @@ def assemble(request_id: str) -> dict[str, Any] | None:
                 intervals.append(("prefill", a, ft, {"seq_id": sid}))
                 end = fin.get(sid, t1)
                 intervals.append(("decode_active", ft, end, {"seq_id": sid}))
+    if intervals:
+        # Fleet hops can nest a later leg's spans under an already-closed
+        # root (an in-process failover leg adopts the journey's existing
+        # trace), so the request window must cover every collected
+        # interval, not just the root span.
+        t0 = min([t0] + [iv[1] for iv in intervals])
+        t1 = max([t1] + [iv[2] for iv in intervals])
     tool_ivs = [
         iv for iv in _tool_windows_from_events(events)
         if t0 <= iv[1] <= t1 or t0 <= iv[2] <= t1
@@ -270,8 +277,46 @@ def assemble(request_id: str) -> dict[str, Any] | None:
         d = dict(e)
         d["t_ms"] = round((d.pop("ts") - t0) * 1e3, 3)
         ev_out.append(d)
+
+    # Wall-clock anchor for cross-process stitching: perf_counter spans
+    # are process-local, so the fleet stitcher needs the absolute wall
+    # instant of this timeline's origin. Derived from any flight event
+    # (which carries both clocks), else from the current instant — both
+    # clocks advance at the same rate, so the conversion holds.
+    anchor = next((e for e in events if "wall" in e), None)
+    if anchor is not None:
+        t0_wall = anchor["wall"] - (anchor["ts"] - t0)
+    else:
+        t0_wall = time.time() - (now - t0)
+
+    # Replica-tagged hop windows: the router stamps every dispatched leg
+    # with a fleet hop, the frontend tags the adopted span tree with the
+    # serving replica (root attrs for leg 1, nested fleet_hop spans for
+    # later legs), and the stitcher uses these windows to split a shared
+    # in-process trace into per-replica lanes.
+    fleet_legs: list[dict[str, Any]] = []
+    if trace is not None:
+        if trace.root.attrs.get("replica"):
+            fleet_legs.append({
+                "replica": str(trace.root.attrs["replica"]),
+                "hop": str(trace.root.attrs.get("hop", "route")),
+                "start_ms": 0.0,
+                "end_ms": round((t1 - t0) * 1e3, 3),
+            })
+        for c in list(trace.root.children):
+            if c.name != "fleet_hop":
+                continue
+            c1 = c.t1 if c.t1 is not None else now
+            fleet_legs.append({
+                "replica": str(c.attrs.get("replica", "")),
+                "hop": str(c.attrs.get("hop", "")),
+                "start_ms": round((c.t0 - t0) * 1e3, 3),
+                "end_ms": round((c1 - t0) * 1e3, 3),
+            })
     return {
         "request_id": request_id,
+        "t0_wall": t0_wall,
+        "fleet_legs": fleet_legs,
         "duration_ms": round(total_ms, 3),
         "finished": trace.finished if trace is not None else None,
         # Distinct engine generations this request's events span: one
@@ -354,4 +399,332 @@ def render_gantt(timeline: dict[str, Any], width: int = 64) -> str:
             f"tool overlap hidden behind decode: "
             f"{timeline.get('tool_overlap_ms', 0.0):.1f} ms"
         )
+    return "\n".join(lines)
+
+
+# -- fleet stitching -----------------------------------------------------------
+def _union_ms(spans: list[tuple[float, float]]) -> float:
+    """Total length of the union of [a, b] intervals (units in == out)."""
+    total = 0.0
+    end = float("-inf")
+    for a, b in sorted(spans):
+        if b <= end:
+            continue
+        total += b - max(a, end)
+        end = b
+    return total
+
+
+def _lane_from_hops(
+    hops: list[dict[str, Any]], wall: float
+) -> str | None:
+    """The replica of the latest router hop dispatched at or before
+    ``wall`` — the time-partition fallback when a segment carries no
+    replica tag of its own."""
+    lane = None
+    for h in sorted(hops, key=lambda h: h.get("wall", 0.0)):
+        if h.get("replica") and h.get("wall", 0.0) <= wall + 1e-6:
+            lane = h["replica"]
+    if lane is None and hops:
+        lane = hops[0].get("replica")
+    return lane
+
+
+def stitch_fleet(
+    request_id: str,
+    sources: dict[str, dict[str, Any]],
+    journey: dict[str, Any] | None = None,
+    offsets: dict[str, float] | None = None,
+    reaped: list[str] | None = None,
+    events: list[dict[str, Any]] | None = None,
+) -> dict[str, Any]:
+    """Merge per-replica ``assemble()`` timelines into one fleet journey.
+
+    ``sources`` maps a replica id — or the sentinel ``"_shared"`` for an
+    in-process fleet whose replicas share this process's trace store — to
+    that replica's timeline dict. Remote segments are shifted onto the
+    router's clock by subtracting ``offsets[replica]`` (replica wall
+    MINUS router wall, registry.clock_offsets) before ordering, so two
+    replicas' lanes interleave correctly even with skewed wall clocks;
+    the ``"_shared"`` source is already on the router's clock and is
+    split into lanes by its replica-tagged ``fleet_legs`` windows (hop
+    time-partition as fallback). ``journey`` is the router's participants
+    record (t0_wall / shape / replicas / hops), ``events`` the merged
+    flight events already attributed to this request (router windows —
+    failover, hedge, retry, fault-in — are derived from them), and
+    ``reaped`` names participants the registry no longer knows: their
+    segments are lost and the stitch degrades to the survivors, loudly.
+    """
+    offsets = offsets or {}
+    journey = journey or {}
+    events = events or []
+    reaped = list(reaped or [])
+    hops = list(journey.get("hops") or [])
+
+    def _ev_wall(e: dict[str, Any]) -> float:
+        return e.get("wall_corrected", e.get("wall", 0.0))
+
+    # Per-source phase segments -> absolute router-frame wall seconds.
+    raw: list[dict[str, Any]] = []
+    for src, tl in sources.items():
+        t0w = tl.get("t0_wall")
+        if t0w is None:
+            continue
+        legs = sorted(
+            tl.get("fleet_legs") or [], key=lambda g: g["start_ms"]
+        )
+        src_off = 0.0 if src == "_shared" else offsets.get(src, 0.0)
+        for seg in tl.get("phases", []):
+            mid = (seg["start_ms"] + seg["end_ms"]) / 2.0
+            lane = None
+            if src != "_shared":
+                lane = src
+            else:
+                # Innermost (latest-starting) replica-tagged leg window
+                # containing the segment midpoint: failover legs nest
+                # inside the journey root's window, so the latest match
+                # is the replica that actually ran this segment.
+                for leg in legs:
+                    if (
+                        leg.get("replica")
+                        and leg["start_ms"] - 1e-6 <= mid
+                        <= leg["end_ms"] + 1e-6
+                    ):
+                        lane = leg["replica"]
+                if lane is None:
+                    lane = _lane_from_hops(hops, t0w + mid / 1e3)
+            raw.append({
+                "replica": lane or "?",
+                "phase": seg["phase"],
+                "a": t0w + seg["start_ms"] / 1e3 - src_off,
+                "b": t0w + seg["end_ms"] / 1e3 - src_off,
+                **(
+                    {"attrs": seg["attrs"]} if seg.get("attrs") else {}
+                ),
+            })
+
+    # Router-side windows from the journey's flight events: what the
+    # replicas' own lanes can never show (the gap between a dying leg
+    # and its failover re-dispatch, hedge launches, peer fault-in
+    # fetch windows, the routing interval before the first dispatch).
+    windows: list[dict[str, Any]] = []
+    evs = sorted(events, key=_ev_wall)
+    hop_walls = sorted(h.get("wall", 0.0) for h in hops)
+
+    def _next_hop_after(w: float) -> float | None:
+        for hw in hop_walls:
+            if hw > w:
+                return hw
+        return None
+
+    jt0 = journey.get("t0_wall")
+    if jt0 is not None and hop_walls:
+        windows.append({
+            "kind": "routing", "a": jt0, "b": max(jt0, hop_walls[0]),
+        })
+    open_fault: list[dict[str, Any]] = []
+    for e in evs:
+        k, w = e.get("kind"), _ev_wall(e)
+        if k == "failover":
+            nxt = _next_hop_after(w)
+            windows.append({
+                "kind": "failover", "a": w,
+                "b": nxt if nxt is not None else w,
+                "replica": e.get("replica"),
+            })
+        elif k == "fleet_retry":
+            nxt = _next_hop_after(w)
+            windows.append({
+                "kind": "retry", "a": w,
+                "b": nxt if nxt is not None else w,
+                "replica": e.get("replica"),
+            })
+        elif k == "fleet_hedge":
+            windows.append({
+                "kind": "hedge", "a": w, "b": w,
+                "primary": e.get("primary"), "backup": e.get("backup"),
+            })
+        elif k == "page_fault_in":
+            if e.get("phase") == "enter":
+                open_fault.append(e)
+            elif e.get("phase") == "exit" and open_fault:
+                ent = open_fault.pop()
+                windows.append({
+                    "kind": "fault_in", "a": _ev_wall(ent), "b": w,
+                    "replica": e.get("replica"),
+                    "outcome": e.get("outcome"),
+                    "pages": e.get("pages", 0),
+                })
+
+    anchors = [s["a"] for s in raw] + [w["a"] for w in windows]
+    ends = [s["b"] for s in raw] + [w["b"] for w in windows]
+    if jt0 is not None and (anchors or ends):
+        anchors.append(jt0)
+    if not anchors:
+        return {
+            "request_id": request_id, "fleet": True,
+            "shape": journey.get("shape", "direct"),
+            "replicas": [], "reaped": reaped, "clock_offset_ms": {},
+            "t0_wall": jt0, "duration_ms": 0.0,
+            "goodput": {"coverage": 0.0}, "coverage": 0.0,
+            "lanes": {}, "segments": [], "windows": [], "events": [],
+        }
+    T0 = min(anchors)
+    T1 = max(ends) if ends else T0
+    total_ms = max(1e-9, (T1 - T0) * 1e3)
+
+    def _rel(x: float) -> float:
+        return round((x - T0) * 1e3, 3)
+
+    ordered = sorted(raw, key=lambda s: (s["a"], s["b"]))
+    lanes: dict[str, list[dict[str, Any]]] = {}
+    for s in ordered:
+        seg = {
+            "replica": s["replica"], "phase": s["phase"],
+            "start_ms": _rel(s["a"]), "end_ms": _rel(s["b"]),
+            "duration_ms": round((s["b"] - s["a"]) * 1e3, 3),
+        }
+        if "attrs" in s:
+            seg["attrs"] = s["attrs"]
+        lanes.setdefault(s["replica"], []).append(seg)
+
+    # Flattened, monotonic, non-overlapping segment list: the stitched
+    # cross-replica ordering. Residual overlaps (clock-offset estimate
+    # jitter, or a hedge loser's concurrent probe) clamp to the previous
+    # segment's end; a fully-swallowed segment drops out.
+    flat: list[dict[str, Any]] = []
+    cursor = float("-inf")
+    for s in ordered:
+        a, b = max(s["a"], cursor), s["b"]
+        if b - a <= 1e-9:
+            continue
+        flat.append({
+            "replica": s["replica"], "phase": s["phase"],
+            "start_ms": _rel(a), "end_ms": _rel(b),
+            "duration_ms": round((b - a) * 1e3, 3),
+        })
+        cursor = b
+
+    cov_union = _union_ms(
+        [(s["a"], s["b"]) for s in raw]
+        + [(w["a"], w["b"]) for w in windows]
+    )
+    coverage = (
+        round(min(1.0, cov_union / (T1 - T0)), 4) if T1 > T0 else 1.0
+    )
+    by_phase: dict[str, float] = {}
+    for s in flat:
+        by_phase[s["phase"]] = (
+            by_phase.get(s["phase"], 0.0) + s["duration_ms"]
+        )
+    goodput = {
+        p: round(v / total_ms, 4) for p, v in sorted(by_phase.items())
+    }
+    goodput["coverage"] = coverage
+
+    win_out = []
+    for w in sorted(windows, key=lambda w: (w["a"], w["b"])):
+        d = {k: v for k, v in w.items() if k not in ("a", "b")}
+        d["start_ms"] = _rel(w["a"])
+        d["end_ms"] = _rel(w["b"])
+        d["duration_ms"] = round((w["b"] - w["a"]) * 1e3, 3)
+        win_out.append(d)
+    ev_out = []
+    for e in evs:
+        d = dict(e)
+        d.pop("ts", None)
+        d["t_ms"] = _rel(_ev_wall(e))
+        ev_out.append(d)
+
+    replicas = [
+        r for r in (journey.get("replicas") or []) if r in lanes
+    ]
+    replicas += [r for r in lanes if r not in replicas]
+    return {
+        "request_id": request_id,
+        "fleet": True,
+        "shape": journey.get("shape", "direct"),
+        "replicas": replicas,
+        "reaped": reaped,
+        "clock_offset_ms": {
+            r: round(offsets.get(r, 0.0) * 1e3, 3) for r in replicas
+        },
+        "t0_wall": T0,
+        "duration_ms": round(total_ms, 3),
+        "goodput": goodput,
+        "coverage": coverage,
+        "lanes": lanes,
+        "segments": flat,
+        "windows": win_out,
+        "events": ev_out,
+    }
+
+
+def render_fleet_gantt(stitched: dict[str, Any], width: int = 64) -> str:
+    """ASCII multi-lane Gantt of a stitched fleet journey: one lane of
+    rows per participating replica plus the router/fleet windows, all on
+    one shared (skew-corrected) time axis."""
+    total = max(1e-9, float(stitched.get("duration_ms", 0.0)))
+    replicas = stitched.get("replicas") or []
+    lines = [
+        f"fleet journey {stitched.get('request_id', '?')}  "
+        f"{total:.1f} ms total  shape={stitched.get('shape', 'direct')}  "
+        f"replicas={len(replicas)}"
+    ]
+    reaped = stitched.get("reaped") or []
+    if reaped:
+        lines.append(
+            "degraded: participant(s) reaped, segments lost: "
+            + ", ".join(reaped)
+        )
+    offs = stitched.get("clock_offset_ms") or {}
+    if any(offs.values()):
+        lines.append(
+            "clock offsets vs router: "
+            + "  ".join(f"{r} {v:+.3f} ms" for r, v in offs.items())
+        )
+    g = stitched.get("goodput", {})
+    if g:
+        lines.append(
+            "goodput: "
+            + "  ".join(
+                f"{p} {100.0 * v:.1f}%"
+                for p, v in g.items() if p != "coverage" and v
+            )
+            + f"  (coverage {100.0 * g.get('coverage', 0.0):.1f}%)"
+        )
+    lanes = stitched.get("lanes") or {}
+    windows = stitched.get("windows") or []
+    name_w = max(
+        [len(s["phase"]) for segs in lanes.values() for s in segs]
+        + [len(w["kind"]) for w in windows] + [5]
+    )
+
+    def _row(name: str, start_ms: float, end_ms: float, dur_ms: float,
+             tag: str = "") -> str:
+        a = int(round(start_ms / total * width))
+        b = int(round(end_ms / total * width))
+        b = min(width, max(b, a + 1))
+        bar = _PAD * a + _BAR * (b - a) + _PAD * (width - b)
+        return f"  {name:<{name_w}s} |{bar}| {dur_ms:8.1f} ms{tag}"
+
+    for r in replicas:
+        lines.append(f"lane {r}:")
+        for seg in lanes.get(r, []):
+            lines.append(_row(
+                seg["phase"], seg["start_ms"], seg["end_ms"],
+                seg["duration_ms"],
+            ))
+    if windows:
+        lines.append("router/fleet windows:")
+        for w in windows:
+            tag = ""
+            if w.get("replica"):
+                tag = f" replica={w['replica']}"
+            if w["kind"] == "fault_in" and w.get("pages") is not None:
+                tag += f" pages={w['pages']}"
+            lines.append(_row(
+                w["kind"], w["start_ms"], w["end_ms"],
+                w["duration_ms"], tag,
+            ))
     return "\n".join(lines)

@@ -35,6 +35,19 @@ from .scheduler import Request, RequestError, Scheduler
 log = get_logger("serving.api")
 
 
+class _SpanFinisher:
+    """Duck-types ``Trace.finish()`` for a nested fleet-hop leg span:
+    the completion paths call ``owned.finish()`` in their finally
+    blocks, and a leg span that never closed would extend to "now"
+    forever in the assembled timeline."""
+
+    def __init__(self, span: Any):
+        self._span = span
+
+    def finish(self) -> None:
+        self._span.close()
+
+
 class ServingStack:
     """Engine + scheduler + chat glue for one hosted model."""
 
@@ -295,23 +308,49 @@ class ServingStack:
 
     # -- chat.completions ---------------------------------------------------
     def _request_trace(
-        self,
-    ) -> tuple[obs.Trace | None, "obs.Span | None", str]:
+        self, hop: dict[str, Any] | None = None,
+    ) -> tuple[Any, "obs.Span | None", str]:
         """Trace context for one chat completion: nest under the caller's
         current span when one is active (the in-process tpu:// path — the
         ReAct loop's ``llm_turn`` span), otherwise root a NEW trace whose
         request ID doubles as the OpenAI completion id, so
         ``GET /api/trace/<completion id>`` finds it. Returns
-        (owned_trace_or_None, parent_span, completion_id)."""
+        (owned_handle_or_None, parent_span, completion_id).
+
+        ``hop`` is the fleet router's hop stamp ({request_id, hop,
+        replica}): the incoming journey ID is ADOPTED instead of minting
+        a fresh one, so trace spans, flight events, and attribution on
+        every participating replica key to one ID. When this process
+        already holds a trace under that ID (in-process fleet: a hedge
+        probe or mid-stream failover leg landing beside the first leg),
+        the new leg nests as a ``fleet_hop`` child of the existing root —
+        one span tree per journey, mirroring engine-restart stitching."""
         parent = obs.current_span()
         if parent is not None:
             return None, parent, f"chatcmpl-{uuid.uuid4().hex[:24]}"
-        t = obs.Trace(obs.new_request_id("chatcmpl"))
+        rid = str(hop.get("request_id") or "") if hop else ""
+        if rid:
+            existing = obs.get_store().get(rid)
+            if existing is not None:
+                leg = existing.root.start_child(
+                    "fleet_hop",
+                    hop=str(hop.get("hop", "")),
+                    replica=str(hop.get("replica", "")),
+                )
+                return _SpanFinisher(leg), leg, rid
+        t = obs.Trace(rid or obs.new_request_id("chatcmpl"))
+        if hop:
+            t.root.set(
+                hop=str(hop.get("hop", "")),
+                replica=str(hop.get("replica", "")),
+            )
         obs.get_store().add(t)
         return t, t.root, t.request_id
 
     def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
-        owned, parent, cid = self._request_trace()
+        hop = body.pop("fleet_hop", None) if isinstance(body, dict) \
+            else None
+        owned, parent, cid = self._request_trace(hop)
         try:
             return self._chat_completion_traced(body, parent, cid)
         finally:
@@ -438,6 +477,8 @@ class ServingStack:
 
     def chat_completion_stream(self, body: dict[str, Any]):
         """Generator of SSE chunk dicts (sync; drive from a thread)."""
+        hop = body.pop("fleet_hop", None) if isinstance(body, dict) \
+            else None
         sampling, prompt_ids, mask_fn = self._translate(body)
         if sampling.logprobs:
             # Refuse rather than silently dropping the field (and paying
@@ -452,7 +493,7 @@ class ServingStack:
         if n != 1:
             raise RequestError("n > 1 is not supported with stream", 400)
         token_q: "queue.Queue[int | None]" = queue.Queue()
-        owned, parent, cid = self._request_trace()
+        owned, parent, cid = self._request_trace(hop)
         gen_span = (
             parent.start_child("generate", stream=True)
             if parent is not None else None
@@ -759,6 +800,20 @@ def build_engine_app(stack: ServingStack, membership=None):
             return web.json_response(
                 {"error": {"message": "messages is required"}}, status=400
             )
+        # Fleet hop annotation: the router stamps the journey both in
+        # the body (primary carrier) and as X-Fleet-* headers; accept
+        # the headers so a front proxy that strips unknown body fields
+        # still propagates the journey ID to this replica's spans.
+        if "fleet_hop" not in body:
+            hdr_rid = request.headers.get("X-Fleet-Request-Id")
+            if hdr_rid:
+                body["fleet_hop"] = {
+                    "request_id": hdr_rid,
+                    "hop": request.headers.get("X-Fleet-Hop", ""),
+                    "replica": request.headers.get(
+                        "X-Fleet-Replica", ""
+                    ),
+                }
         loop = asyncio.get_running_loop()
         if body.get("stream"):
             gen = stack.chat_completion_stream(body)

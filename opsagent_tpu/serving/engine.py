@@ -1260,7 +1260,10 @@ class Engine:
         # the same chain keys, so the locked restore below picks them up
         # through the unchanged _restore_from_host path. Never raises;
         # a miss/failure just means the prefill loop covers the tokens.
-        faulted_pages = self.fault_in_prefix(prompt_ids)
+        faulted_pages = self.fault_in_prefix(
+            prompt_ids,
+            request_id=obs.flight.request_id_of(trace) or "",
+        )
         with self.lock:
             if self.offload is not None:
                 # Land pending spills first: a page parked during the
@@ -3179,14 +3182,18 @@ class Engine:
         by the digest cap (registry snapshot: ``digest_truncated``)."""
         return self._digests_truncated
 
-    def fault_in_prefix(self, prompt_ids: list[int]) -> int:
+    def fault_in_prefix(
+        self, prompt_ids: list[int], request_id: str = ""
+    ) -> int:
         """Fleet-global KV fault-in (tier 3): when the usable prefix of
         ``prompt_ids`` misses the HBM trie AND the host pool, ask the
         fleet page directory who owns the missing chain and fetch it
         peer-to-peer into the host pool (fleet/pagestore.py), so the
         admission's ordinary host restore lands it. Probes under the
         engine lock (cheap reads), fetches OUTSIDE it. Returns pages
-        landed; 0 on any miss/failure — never raises into admission."""
+        landed; 0 on any miss/failure — never raises into admission.
+        ``request_id`` tags the fault-in flight events with the journey
+        this admission serves (fleet timeline stitching)."""
         if self.pagestore is None or self.offload is None:
             return 0
         try:
@@ -3203,7 +3210,8 @@ class Engine:
             if matched + covered >= total:
                 return 0  # local tiers cover it — no fetch
             return self.pagestore.fault_in(
-                usable, start_page=matched + covered
+                usable, start_page=matched + covered,
+                request_id=request_id,
             )
         except Exception:  # noqa: BLE001 - NEVER raises into admission
             log.exception("page fault-in probe failed; re-prefilling")

@@ -61,6 +61,13 @@ class FleetMembership:
         self._jitter = random.Random(self.replica_id)
         self._register_backoff_s = 0.0
         self._next_register_s = 0.0
+        # Clock-sync echo: the router's wall clock from the last
+        # register/heartbeat response, plus the local monotonic instant
+        # it arrived. The NEXT beat echoes the timestamp together with
+        # the held duration, giving the registry one RTT + clock-offset
+        # sample per beat (registry.ClockSync).
+        self._echo_router_ts: float | None = None
+        self._echo_rx_mono: float = 0.0
 
     # -- wire ----------------------------------------------------------------
     def _post(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
@@ -73,6 +80,12 @@ class FleetMembership:
             req, timeout=10.0
         ) as resp:
             return json.loads(resp.read().decode("utf-8"))
+
+    def _note_router_ts(self, resp: dict[str, Any]) -> None:
+        ts = resp.get("router_ts") if isinstance(resp, dict) else None
+        if isinstance(ts, (int, float)):
+            self._echo_router_ts = float(ts)
+            self._echo_rx_mono = time.monotonic()
 
     def _payload(self, full: bool) -> dict[str, Any]:
         eng = self.stack.engine
@@ -92,7 +105,11 @@ class FleetMembership:
             "digest_truncated": bool(
                 getattr(eng, "digests_truncated", lambda: False)()
             ),
+            "replica_ts": time.time(),
         }
+        if self._echo_router_ts is not None:
+            body["echo_router_ts"] = self._echo_router_ts
+            body["echo_held_s"] = time.monotonic() - self._echo_rx_mono
         if full:
             body.update({
                 "url": self.advertise_url,
@@ -109,7 +126,9 @@ class FleetMembership:
     # -- lifecycle -----------------------------------------------------------
     def register(self) -> bool:
         try:
-            self._post("/fleet/register", self._payload(full=True))
+            self._note_router_ts(
+                self._post("/fleet/register", self._payload(full=True))
+            )
         except Exception as e:  # noqa: BLE001 - router may not be up yet
             log.warning("fleet registration failed (will retry): %s", e)
             self.registered = False
@@ -158,7 +177,11 @@ class FleetMembership:
                 self.last_heartbeat_ok = False
                 continue
             try:
-                self._post("/fleet/heartbeat", self._payload(full=False))
+                self._note_router_ts(
+                    self._post(
+                        "/fleet/heartbeat", self._payload(full=False)
+                    )
+                )
                 self.last_heartbeat_ok = True
             except urllib.error.HTTPError as e:
                 self.last_heartbeat_ok = False

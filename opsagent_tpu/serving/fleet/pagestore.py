@@ -262,17 +262,29 @@ class PageStoreClient:
         )
         return 0
 
-    def fault_in(self, token_ids: list[int], start_page: int) -> int:
+    def fault_in(
+        self, token_ids: list[int], start_page: int,
+        request_id: str = "",
+    ) -> int:
         """Fetch pages ``start_page..`` of ``token_ids`` (a page-aligned
         usable prefix) from peers into the local host pool. Returns
-        pages landed (0 on any failure — the caller re-prefills)."""
+        pages landed (0 on any failure — the caller re-prefills).
+        ``request_id`` tags the flight events with the journey this
+        fetch serves so the fleet stitcher can draw the fault-in
+        window."""
         try:
-            return self._fault_in(token_ids, start_page)
+            return self._fault_in(token_ids, start_page, request_id)
         except Exception:  # noqa: BLE001 - NEVER raises into admission
             log.exception("page fault-in failed; re-prefilling")
-            return self._fallback("error")
+            return self._fallback(
+                "error",
+                **({"request_id": request_id} if request_id else {}),
+            )
 
-    def _fault_in(self, token_ids: list[int], start_page: int) -> int:
+    def _fault_in(
+        self, token_ids: list[int], start_page: int,
+        request_id: str = "",
+    ) -> int:
         P = self.page_size
         total = len(token_ids) // P
         if start_page >= total:
@@ -282,12 +294,15 @@ class PageStoreClient:
             for i in range(start_page, total)
         }
         keys = list(missing)
+        rid_field = {"request_id": request_id} if request_id else {}
         obs.PAGESTORE_LOOKUPS.inc(len(keys))
         try:
             owners_map = self.lookup(keys)
         except Exception:  # noqa: BLE001 - directory unreachable
             log.exception("pagestore directory lookup failed")
-            return self._fallback("lookup_error", chains=len(keys))
+            return self._fallback(
+                "lookup_error", chains=len(keys), **rid_field
+            )
         # Rank candidate peers by how many missing chains they cover
         # (the deepest-coverage owner almost always holds the whole
         # suffix — chains are prefixes of each other).
@@ -305,12 +320,14 @@ class PageStoreClient:
                 )
                 claims.setdefault(rid, []).append(key)
         if not coverage:
-            return self._fallback("no_owner", chains=len(keys))
+            return self._fallback(
+                "no_owner", chains=len(keys), **rid_field
+            )
         ranked = sorted(coverage, key=lambda r: -coverage[r])
         obs.flight.record(
             "page_fault_in", phase="enter", replica=self.self_id,
             chains=len(keys), start_page=start_page,
-            candidates=len(ranked),
+            candidates=len(ranked), **rid_field,
         )
         t0 = time.perf_counter()
         landed = 0
@@ -390,10 +407,12 @@ class PageStoreClient:
             self.fallbacks += 1
             obs.PAGESTORE_FALLBACKS.inc(reason="miss")
         obs.PAGESTORE_FETCH_SECONDS.observe(dt)
+        if request_id:
+            obs.FLEET_HOP_SECONDS.observe(dt, hop="fault_in")
         obs.flight.record(
             "page_fault_in", phase="exit", outcome=outcome,
             replica=self.self_id, pages=landed, bytes=nbytes,
-            ms=round(dt * 1e3, 3),
+            ms=round(dt * 1e3, 3), **rid_field,
         )
         return landed
 

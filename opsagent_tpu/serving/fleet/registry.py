@@ -98,6 +98,41 @@ class ReplicaHealth:
         )
 
 
+CLOCK_EWMA_ALPHA = 0.3            # weight of the newest heartbeat sample
+
+
+@dataclass
+class ClockSync:
+    """Per-replica clock model from heartbeat timestamp echoes.
+
+    Each heartbeat response carries the router's wall clock at send
+    (``router_ts``); the replica echoes it on its NEXT beat together
+    with how long it held it (``echo_held_s``, measured on the
+    replica's monotonic clock) and its own wall clock at send
+    (``replica_ts``). On receipt at router wall time ``now``:
+
+        rtt    = (now - echo_router_ts) - echo_held_s
+        offset = replica_ts - (now - rtt / 2)
+
+    ``offset_s`` is the replica's wall clock MINUS the router's — the
+    correction the fleet timeline stitcher subtracts from a replica's
+    segment timestamps. Both estimates are EWMAs so one delayed beat
+    cannot yank the fleet's time axis."""
+
+    offset_s: float = 0.0
+    rtt_s: float = 0.0
+    samples: int = 0
+
+    def update(self, offset_s: float, rtt_s: float) -> None:
+        if self.samples == 0:
+            self.offset_s, self.rtt_s = offset_s, rtt_s
+        else:
+            a = CLOCK_EWMA_ALPHA
+            self.offset_s += a * (offset_s - self.offset_s)
+            self.rtt_s += a * (rtt_s - self.rtt_s)
+        self.samples += 1
+
+
 def prompt_chain_keys(token_ids: list[int], page_size: int) -> list[str]:
     """Hex chain keys of every page-aligned prefix of ``token_ids[:-1]``
     (minus the last token, mirroring admission's match_prefix: at least
@@ -192,6 +227,7 @@ class ReplicaRegistry:
         self._lock = threading.Lock()
         self._replicas: dict[str, ReplicaInfo] = {}
         self._health: dict[str, ReplicaHealth] = {}
+        self._clocks: dict[str, ClockSync] = {}
         self.reaped = 0
         # Fleet-global KV directory: chain_key_hex -> owning replicas,
         # kept in lockstep with the digest advertisements above.
@@ -205,6 +241,12 @@ class ReplicaRegistry:
             # A (re-)registration is a fresh process (or an operator's
             # explicit rejoin): start from a clean health slate.
             self._health[info.replica_id] = ReplicaHealth()
+            # In-process replicas share the router's clock: their offset
+            # is identically zero, no echo protocol needed.
+            self._clocks[info.replica_id] = (
+                ClockSync(0.0, 0.0, 1) if info.local else ClockSync()
+            )
+        obs.FLEET_CLOCK_SKEW.set(0.0, replica=info.replica_id)
         self.directory.update(info.replica_id, info.digests)
         log.info(
             "replica %s registered (role=%s model=%s url=%s capacity=%d "
@@ -219,15 +261,38 @@ class ReplicaRegistry:
         load: dict[str, Any] | None = None,
         digests: list[str] | None = None,
         digest_truncated: bool | None = None,
+        replica_ts: float | None = None,
+        echo_router_ts: float | None = None,
+        echo_held_s: float | None = None,
     ) -> bool:
         """Refresh liveness (+ optionally load/digests). Returns False
         for unknown ids — the replica should re-register (it was reaped
-        or the router restarted)."""
+        or the router restarted).
+
+        ``replica_ts`` / ``echo_router_ts`` / ``echo_held_s`` are the
+        clock-sync echo: the replica's wall clock at send, the router
+        timestamp from the PREVIOUS heartbeat response, and how long
+        the replica held it (monotonic). Together they yield one RTT +
+        clock-offset sample (see ClockSync)."""
         with self._lock:
             info = self._replicas.get(replica_id)
             if info is None:
                 return False
             info.last_heartbeat = time.monotonic()
+            if (
+                replica_ts is not None
+                and echo_router_ts is not None
+                and echo_held_s is not None
+                and not info.local
+            ):
+                now = time.time()
+                rtt = max(0.0, (now - echo_router_ts) - echo_held_s)
+                offset = replica_ts - (now - rtt / 2.0)
+                clock = self._clocks.setdefault(replica_id, ClockSync())
+                clock.update(offset, rtt)
+                obs.FLEET_CLOCK_SKEW.set(
+                    clock.offset_s, replica=replica_id
+                )
             if load is not None:
                 info.load = dict(load)
             if digests is not None:
@@ -247,6 +312,8 @@ class ReplicaRegistry:
         with self._lock:
             gone = self._replicas.pop(replica_id, None)
             self._health.pop(replica_id, None)
+            self._clocks.pop(replica_id, None)
+        obs.FLEET_CLOCK_SKEW.set(0.0, replica=replica_id)
         self.directory.remove_replica(replica_id)
         if gone is not None:
             log.info("replica %s deregistered", replica_id)
@@ -304,6 +371,7 @@ class ReplicaRegistry:
                     dead.append(rid)
                     del self._replicas[rid]
                     self._health.pop(rid, None)
+                    self._clocks.pop(rid, None)
         for rid in dead:
             self.reaped += 1
             self.directory.remove_replica(rid)
@@ -434,19 +502,53 @@ class ReplicaRegistry:
         with self._lock:
             return self._health.get(replica_id)
 
-    def health_snapshot(self) -> dict[str, str]:
+    def clock_of(self, replica_id: str) -> ClockSync | None:
         with self._lock:
-            return {rid: h.state for rid, h in self._health.items()}
+            return self._clocks.get(replica_id)
+
+    def clock_offsets(self) -> dict[str, float]:
+        """Per-replica clock-offset estimates in seconds (replica wall
+        minus router wall); replicas without a converged estimate are
+        reported at 0 — the stitcher then trusts their timestamps."""
+        with self._lock:
+            return {
+                rid: (c.offset_s if c.samples else 0.0)
+                for rid, c in self._clocks.items()
+            }
+
+    def health_snapshot(
+        self, clock: bool = False
+    ) -> dict[str, str] | dict[str, dict[str, Any]]:
+        """Per-replica breaker state; ``clock=True`` widens each value
+        to ``{"state", "clock_offset_s", "clock_rtt_s",
+        "clock_samples"}`` for surfaces that also want the skew
+        estimate (router /healthz, registry snapshot)."""
+        with self._lock:
+            if not clock:
+                return {rid: h.state for rid, h in self._health.items()}
+            out: dict[str, dict[str, Any]] = {}
+            for rid, h in self._health.items():
+                c = self._clocks.get(rid) or ClockSync()
+                out[rid] = {
+                    "state": h.state,
+                    "clock_offset_s": round(c.offset_s, 6),
+                    "clock_rtt_s": round(c.rtt_s, 6),
+                    "clock_samples": c.samples,
+                }
+            return out
 
     def all(self) -> list[ReplicaInfo]:
         with self._lock:
             return list(self._replicas.values())
 
     def snapshot(self) -> dict[str, Any]:
-        health = self.health_snapshot()
+        health = self.health_snapshot(clock=True)
         rows = [i.snapshot() for i in self.all()]
         for row in rows:
-            row["health"] = health.get(row["id"], "healthy")
+            h = health.get(row["id"])
+            row["health"] = h["state"] if h else "healthy"
+            row["clock_offset_s"] = h["clock_offset_s"] if h else 0.0
+            row["clock_rtt_s"] = h["clock_rtt_s"] if h else 0.0
         return {
             "replicas": rows,
             "heartbeat_ttl_s": self.ttl_s,

@@ -49,6 +49,7 @@ from __future__ import annotations
 import copy
 import hashlib
 import json
+import os
 import queue as queue_mod
 import random
 import threading
@@ -242,6 +243,11 @@ class LocalReplica:
     def timeline(self, request_id: str) -> dict[str, Any] | None:
         return obs.timeline.assemble(request_id)
 
+    def flight(
+        self, n: int = 256, kind: str = ""
+    ) -> list[dict[str, Any]]:
+        return obs.flight.get_recorder().snapshot(n=n, kind=kind or None)
+
     def close(self) -> None:
         self.stack.close()
 
@@ -254,9 +260,25 @@ class HttpReplica:
         self.replica_id = replica_id
         self.timeout_s = timeout_s
 
+    @staticmethod
+    def _hop_headers(body: Any) -> dict[str, str]:
+        """X-Fleet-* hop annotation headers mirroring ``fleet_hop`` in
+        the body (the wire spec: body field primary; the headers let a
+        front proxy that strips unknown body fields still propagate the
+        journey ID to the replica)."""
+        hop = body.get("fleet_hop") if isinstance(body, dict) else None
+        if not isinstance(hop, dict) or not hop.get("request_id"):
+            return {}
+        return {
+            "X-Fleet-Request-Id": str(hop["request_id"]),
+            "X-Fleet-Hop": str(hop.get("hop", "")),
+            "X-Fleet-Replica": str(hop.get("replica", "")),
+        }
+
     def _call(
         self, path: str, body: dict | None = None,
         timeout_s: float | None = None,
+        headers: dict[str, str] | None = None,
     ) -> dict[str, Any]:
         faults.maybe_raise(
             "fleet.connect",
@@ -270,9 +292,11 @@ class HttpReplica:
             replica=self.replica_id, path=path,
         )
         data = None if body is None else json.dumps(body).encode("utf-8")
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         req = urllib.request.Request(
-            self.url + path, data=data,
-            headers={"Content-Type": "application/json"},
+            self.url + path, data=data, headers=hdrs,
         )
         with urllib.request.urlopen(  # noqa: S310 - operator-registered URL
             req, timeout=timeout_s or self.timeout_s
@@ -281,7 +305,10 @@ class HttpReplica:
 
     def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
         try:
-            return self._call("/v1/chat/completions", body)
+            return self._call(
+                "/v1/chat/completions", body,
+                headers=self._hop_headers(body),
+            )
         except urllib.error.HTTPError as e:  # surface the engine's verdict
             try:
                 payload = json.loads(e.read().decode("utf-8"))
@@ -303,7 +330,10 @@ class HttpReplica:
         data = json.dumps(dict(body, stream=True)).encode("utf-8")
         req = urllib.request.Request(
             self.url + "/v1/chat/completions", data=data,
-            headers={"Content-Type": "application/json"},
+            headers={
+                "Content-Type": "application/json",
+                **self._hop_headers(body),
+            },
         )
         with urllib.request.urlopen(  # noqa: S310
             req, timeout=self.timeout_s
@@ -385,6 +415,15 @@ class HttpReplica:
                 return None
             raise
 
+    def flight(
+        self, n: int = 256, kind: str = ""
+    ) -> list[dict[str, Any]]:
+        q = f"?n={int(n)}"
+        if kind:
+            q += f"&kind={kind}"
+        out = self._call(f"/api/debug/flight{q}", timeout_s=10.0)
+        return out.get("events", [])
+
 
 # -- routing decisions --------------------------------------------------------
 @dataclass
@@ -416,6 +455,7 @@ class FleetRouter:
         hedge_queue_depth: int | None = None,
         shed_queue_depth: int | None = None,
         pagestore: bool = True,
+        journeys: bool | None = None,
     ):
         """``sticky=False`` disables session->replica pinning (every turn
         re-places from scratch). ``placement="round_robin"`` replaces the
@@ -441,7 +481,15 @@ class FleetRouter:
         re-prefilling. With the directory on, affinity placement is a
         locality optimization, not a correctness crutch — any live
         replica can serve a known session. ``pagestore=False`` is the
-        A/B OFF phase (pre-directory behavior)."""
+        A/B OFF phase (pre-directory behavior).
+
+        ``journeys`` (default: ``$OPSAGENT_FLEET_JOURNEYS`` != "0")
+        turns on fleet request journeys: the router mints one journey ID
+        per request, stamps every replica hop with it (the engine adopts
+        the ID), tracks participants per request, and serves stitched
+        cross-replica timelines. ``journeys=False`` is the obs-overhead
+        A/B OFF phase — requests route identically but carry no stamps
+        and the participants map stays empty."""
         self.registry = registry or ReplicaRegistry()
         self.affinity = affinity
         self.sticky = sticky
@@ -456,14 +504,28 @@ class FleetRouter:
         self._tokenizer = tokenizer
         self._model_family = model_family
         self.pagestore = pagestore
+        if journeys is None:
+            journeys = os.environ.get(
+                "OPSAGENT_FLEET_JOURNEYS", "1"
+            ) != "0"
+        self.journeys = journeys
         self._lock = threading.Lock()
         self._pins: OrderedDict[str, str] = OrderedDict()     # session->rid
-        self._owners: OrderedDict[str, str] = OrderedDict()   # req id->rid
+        # Append-only participants map (bounded LRU), one record per
+        # journey: every replica hop (route / stream / failover / hedge /
+        # prefill / migrate) appends here, so a hedged or failed-over
+        # request keeps its WHOLE replica set — not just the final
+        # winner (the old ``_owners`` map's partial-story bug).
+        self._participants: OrderedDict[str, dict[str, Any]] = \
+            OrderedDict()
         self._max_map = 8192
         # Elastic scale-out (serving/fleet/autoscale.py). None = static
         # fleet; set by run_router_server or a test harness. The router
         # only feeds it shed pressure — all policy lives in the scaler.
         self.autoscaler: Any = None
+        # Anomaly dumps in this process gain the triggering request's
+        # cross-replica journey (obs/flight.py _dump_context).
+        obs.flight.set_journey_provider(self.journey_of)
 
     # -- membership convenience -------------------------------------------
     def add_local(
@@ -689,20 +751,145 @@ class FleetRouter:
             **({"request_id": request_id} if request_id else {}),
         )
 
-    def _note_ownership(self, d: RouteDecision, resp_id: str | None) -> None:
+    # -- journey bookkeeping -------------------------------------------------
+    # Journey shapes in escalation order: a journey counts once, under
+    # its most eventful shape (a hedged request that then fails over is
+    # a failover journey).
+    _SHAPE_RANK = {"direct": 0, "retried": 1, "hedged": 2, "failover": 3}
+
+    def _new_journey(self) -> str | None:
+        """Mint the journey ID the engine will ADOPT as its completion
+        id (same chatcmpl- namespace) and open its participants record.
+        None when journeys are off (the obs-overhead kill switch)."""
+        if not self.journeys:
+            return None
+        jid = obs.new_request_id("chatcmpl")
+        with self._lock:
+            self._participants[jid] = {
+                "t0_wall": time.time(), "shape": "direct",
+                "replicas": [], "hops": [],
+            }
+            while len(self._participants) > self._max_map:
+                self._participants.popitem(last=False)
+        return jid
+
+    def _stamp_hop(
+        self, body: dict[str, Any], jid: str | None, hop: str,
+        replica_id: str,
+    ) -> dict[str, Any]:
+        """Copy of ``body`` stamped with the fleet hop annotation the
+        engine pops and adopts; the caller's body stays clean so a
+        retry/failover leg restamps with its own hop kind."""
+        if not jid:
+            return body
+        out = dict(body)
+        out["fleet_hop"] = {
+            "request_id": jid, "hop": hop, "replica": replica_id,
+        }
+        return out
+
+    def _note_hop(
+        self, jid: str | None, replica_id: str, hop: str, **extra: Any
+    ) -> None:
+        """Append one hop record to the journey BEFORE dispatching, so
+        the participants map holds every replica the request touched
+        even when the touch fails (the failed leg is exactly the one
+        the postmortem needs)."""
+        if not jid:
+            return
+        with self._lock:
+            rec = self._participants.get(jid)
+            if rec is None:
+                return
+            if replica_id and replica_id not in rec["replicas"]:
+                rec["replicas"].append(replica_id)
+            rec["hops"].append({
+                "hop": hop, "replica": replica_id,
+                "wall": time.time(), **extra,
+            })
+            self._participants.move_to_end(jid)
+
+    def _note_shape(self, jid: str | None, shape: str) -> None:
+        if not jid:
+            return
+        with self._lock:
+            rec = self._participants.get(jid)
+            if rec is not None and (
+                self._SHAPE_RANK.get(shape, 0)
+                > self._SHAPE_RANK.get(rec.get("shape", "direct"), 0)
+            ):
+                rec["shape"] = shape
+
+    def _finish_journey(self, jid: str | None) -> None:
+        """Count the completed journey once, under its final shape."""
+        if not jid:
+            return
+        with self._lock:
+            rec = self._participants.get(jid)
+            if rec is None or rec.get("counted"):
+                return
+            rec["counted"] = True
+            shape = rec.get("shape", "direct")
+        obs.FLEET_JOURNEYS.inc(shape=shape)
+
+    def journey_of(self, request_id: str) -> dict[str, Any] | None:
+        """The cross-replica journey of a tracked request (shape +
+        replicas + hops) — the flight recorder's journey provider, so
+        anomaly dumps naming a request carry its whole fleet story."""
+        with self._lock:
+            rec = self._participants.get(request_id)
+            if rec is None:
+                return None
+            return {
+                "shape": rec.get("shape", "direct"),
+                "replicas": list(rec["replicas"]),
+                "hops": [dict(h) for h in rec["hops"]],
+            }
+
+    def participants_of(self, request_id: str) -> dict[str, Any] | None:
+        with self._lock:
+            rec = self._participants.get(request_id)
+            if rec is None:
+                return None
+            return {
+                "t0_wall": rec.get("t0_wall"),
+                "shape": rec.get("shape", "direct"),
+                "replicas": list(rec["replicas"]),
+                "hops": [dict(h) for h in rec["hops"]],
+            }
+
+    def _note_ownership(
+        self, d: RouteDecision, resp_id: str | None,
+        jid: str | None = None,
+    ) -> None:
         with self._lock:
             if self.sticky:
                 self._pins[d.session] = d.replica.replica_id
                 self._pins.move_to_end(d.session)
                 while len(self._pins) > self._max_map:
                     self._pins.popitem(last=False)
-            if resp_id:
-                self._owners[resp_id] = d.replica.replica_id
-                while len(self._owners) > self._max_map:
-                    self._owners.popitem(last=False)
+            if resp_id and jid and resp_id != jid:
+                # The replica did NOT adopt the journey id (journeys
+                # disabled replica-side, or an older build): alias the
+                # record under the id the client actually holds.
+                rec = self._participants.get(jid)
+                if rec is not None:
+                    self._participants[resp_id] = rec
+                    while len(self._participants) > self._max_map:
+                        self._participants.popitem(last=False)
+            elif resp_id and not jid:
+                # Journeys off router-side: keep a minimal final-replica
+                # record so timeline lookups still resolve.
+                self._participants[resp_id] = {
+                    "t0_wall": time.time(), "shape": "direct",
+                    "replicas": [d.replica.replica_id], "hops": [],
+                }
+                while len(self._participants) > self._max_map:
+                    self._participants.popitem(last=False)
 
     def _maybe_migrate(
-        self, d: RouteDecision, token_ids: list[int] | None, reason: str
+        self, d: RouteDecision, token_ids: list[int] | None, reason: str,
+        jid: str | None = None,
     ) -> None:
         if d.migrate_from is None or not token_ids:
             return
@@ -717,14 +904,15 @@ class FleetRouter:
         src = self.registry.get(d.migrate_from)
         if src is None or src.handle is None or d.replica.handle is None:
             return
+        self._note_hop(jid, d.migrate_from, "migrate", reason=reason)
         migrate_chain(
             src.handle, d.replica.handle, token_ids,
-            reason=reason, session=d.session,
+            reason=reason, session=d.session, request_id=jid or "",
         )
 
     def _maybe_prefill_lane(
         self, d: RouteDecision, body: dict[str, Any],
-        token_ids: list[int] | None,
+        token_ids: list[int] | None, jid: str | None = None,
     ) -> None:
         """Disaggregated prefill: a long cold admission runs its prefill
         on a role=prefill replica, whose KV then flows to the chosen
@@ -746,19 +934,30 @@ class FleetRouter:
             "route_decision", replica=lane.replica_id, policy="prefill",
             affinity_pages=0, queue_depth=lane.queue_depth(),
             session=d.session,
+            **({"request_id": jid} if jid else {}),
         )
+        self._note_hop(jid, lane.replica_id, "prefill")
+        t0 = time.perf_counter()
         try:
             pre_body = dict(body)
             pre_body.pop("stream", None)
             pre_body.pop("n", None)
             pre_body["max_tokens"] = 1
-            lane.handle.chat_completion(pre_body)
+            lane.handle.chat_completion(
+                self._stamp_hop(pre_body, jid, "prefill", lane.replica_id)
+            )
         except Exception:  # noqa: BLE001 - the lane is an optimization
             log.exception("prefill lane failed; decode replica prefills")
             return
+        finally:
+            if jid:
+                obs.FLEET_HOP_SECONDS.observe(
+                    time.perf_counter() - t0, hop="prefill"
+                )
         migrate_chain(
             lane.handle, d.replica.handle, token_ids,
             reason="prefill_handoff", session=d.session,
+            request_id=jid or "",
         )
 
     # -- overload shedding ---------------------------------------------------
@@ -825,28 +1024,43 @@ class FleetRouter:
         return min(others, key=lambda c: c.load_score())
 
     def _hedged_complete(
-        self, body: dict[str, Any], d: RouteDecision, backup: ReplicaInfo
+        self, body: dict[str, Any], d: RouteDecision, backup: ReplicaInfo,
+        jid: str | None = None,
     ) -> tuple[RouteDecision, dict[str, Any]]:
         """Race the admission on primary + backup; first completion wins
         (the loser's work is discarded — greedy outputs are identical).
         Each arrival feeds the circuit breaker; the winner's decision is
         what gets recorded/pinned."""
         obs.FLEET_HEDGES.inc()
+        self._note_shape(jid, "hedged")
         obs.flight.record(
             "fleet_hedge", primary=d.replica.replica_id,
             backup=backup.replica_id, queue_depth=d.queue_depth,
             session=d.session,
+            **({"request_id": jid} if jid else {}),
         )
         results: queue_mod.Queue = queue_mod.Queue()
 
-        def _run(info: ReplicaInfo) -> None:
+        def _run(info: ReplicaInfo, hop: str) -> None:
+            self._note_hop(jid, info.replica_id, hop)
+            t0 = time.perf_counter()
             try:
-                results.put((info, info.handle.chat_completion(body), None))
+                resp = info.handle.chat_completion(
+                    self._stamp_hop(body, jid, hop, info.replica_id)
+                )
+                results.put((info, resp, None))
             except Exception as e:  # noqa: BLE001 - raced; judged below
                 results.put((info, None, e))
+            finally:
+                if jid:
+                    obs.FLEET_HOP_SECONDS.observe(
+                        time.perf_counter() - t0, hop=hop
+                    )
 
-        for info in (d.replica, backup):
-            threading.Thread(target=_run, args=(info,), daemon=True).start()
+        for info, hop in ((d.replica, "route"), (backup, "hedge")):
+            threading.Thread(
+                target=_run, args=(info, hop), daemon=True
+            ).start()
         last_err: Exception | None = None
         for _ in range(2):
             info, resp, err = results.get()
@@ -867,6 +1081,7 @@ class FleetRouter:
     ) -> dict[str, Any]:
         token_ids = self.tokenize(body)
         self._check_overload(force_replica)
+        jid = self._new_journey()
         excluded: set[str] = set()
         attempt = 0
         while True:
@@ -880,17 +1095,27 @@ class FleetRouter:
                 )
             self._maybe_migrate(
                 d, token_ids,
-                reason="failover" if excluded else "misroute",
+                reason="failover" if excluded else "misroute", jid=jid,
             )
             if not excluded:
-                self._maybe_prefill_lane(d, body, token_ids)
+                self._maybe_prefill_lane(d, body, token_ids, jid=jid)
             backup = self._pick_hedge_backup(d) if not excluded else None
             rid_name = d.replica.replica_id
             try:
                 if backup is not None:
-                    d, resp = self._hedged_complete(body, d, backup)
+                    d, resp = self._hedged_complete(body, d, backup, jid=jid)
                 else:
-                    resp = d.replica.handle.chat_completion(body)
+                    self._note_hop(jid, rid_name, "route", attempt=attempt)
+                    t_leg = time.perf_counter()
+                    try:
+                        resp = d.replica.handle.chat_completion(
+                            self._stamp_hop(body, jid, "route", rid_name)
+                        )
+                    finally:
+                        if jid:
+                            obs.FLEET_HOP_SECONDS.observe(
+                                time.perf_counter() - t_leg, hop="route"
+                            )
                     self.registry.note_result(rid_name, ok=True)
             except Exception as e:  # noqa: BLE001 - classified below
                 if backup is None:
@@ -901,18 +1126,21 @@ class FleetRouter:
                 ):
                     attempt += 1
                     excluded.add(rid_name)
+                    self._note_shape(jid, "retried")
                     obs.FLEET_RETRIES.inc()
                     obs.flight.record(
                         "fleet_retry", replica=rid_name, attempt=attempt,
                         error=str(e)[:200],
+                        **({"request_id": jid} if jid else {}),
                     )
                     self._backoff(attempt)
                     continue
                 obs.FLEET_REQUESTS.inc(outcome="error")
                 raise
             rid = resp.get("id") if isinstance(resp, dict) else None
-            self._record_decision(d, request_id=rid)
-            self._note_ownership(d, rid)
+            self._record_decision(d, request_id=rid or jid)
+            self._note_ownership(d, rid, jid)
+            self._finish_journey(jid)
             obs.FLEET_REQUESTS.inc(outcome="completed")
             if isinstance(resp, dict):
                 resp.setdefault("fleet", {})["replica"] = \
@@ -936,6 +1164,7 @@ class FleetRouter:
         continuation would splice two different generations)."""
         token_ids = self.tokenize(body)
         self._check_overload(force_replica)
+        jid = self._new_journey()
         try:
             greedy = float(body.get("temperature") or 0.0) == 0.0
         except (TypeError, ValueError):
@@ -955,15 +1184,20 @@ class FleetRouter:
                 )
             self._maybe_migrate(
                 d, token_ids,
-                reason="failover" if failovers else "misroute",
+                reason="failover" if failovers else "misroute", jid=jid,
             )
             if failovers == 0:
-                self._maybe_prefill_lane(d, body, token_ids)
+                self._maybe_prefill_lane(d, body, token_ids, jid=jid)
             rid_name = d.replica.replica_id
             skip_chars = emitted_chars   # dedup on re-submit
             first = True
+            hop_kind = "failover" if failovers else "stream"
+            self._note_hop(jid, rid_name, hop_kind, failovers=failovers)
+            t_leg = time.perf_counter()
             try:
-                gen = d.replica.handle.chat_completion_stream(body)
+                gen = d.replica.handle.chat_completion_stream(
+                    self._stamp_hop(body, jid, hop_kind, rid_name)
+                )
                 for chunk in gen:
                     faults.maybe_raise(
                         "fleet.stream_disconnect",
@@ -982,8 +1216,8 @@ class FleetRouter:
                     if first:
                         req_id = chunk.get("id") \
                             if isinstance(chunk, dict) else None
-                        self._record_decision(d, request_id=req_id)
-                        self._note_ownership(d, req_id)
+                        self._record_decision(d, request_id=req_id or jid)
+                        self._note_ownership(d, req_id, jid)
                         first = False
                     content = _chunk_content(chunk)
                     if content:
@@ -1002,10 +1236,19 @@ class FleetRouter:
                             continue
                         sent_head = True
                     yield chunk
+                if jid:
+                    obs.FLEET_HOP_SECONDS.observe(
+                        time.perf_counter() - t_leg, hop=hop_kind
+                    )
                 self.registry.note_result(rid_name, ok=True)
+                self._finish_journey(jid)
                 obs.FLEET_REQUESTS.inc(outcome="completed")
                 return
             except Exception as e:  # noqa: BLE001 - classified below
+                if jid:
+                    obs.FLEET_HOP_SECONDS.observe(
+                        time.perf_counter() - t_leg, hop=hop_kind
+                    )
                 self.registry.note_result(rid_name, ok=False)
                 resumable = greedy or emitted_chars == 0
                 if (
@@ -1014,12 +1257,14 @@ class FleetRouter:
                 ):
                     failovers += 1
                     excluded.add(rid_name)
+                    self._note_shape(jid, "failover")
                     obs.FLEET_FAILOVERS.inc()
                     obs.flight.record(
                         "failover", replica=rid_name,
                         failovers=failovers,
                         emitted_chars=emitted_chars,
                         error=str(e)[:200], session=d.session,
+                        **({"request_id": jid} if jid else {}),
                     )
                     self._backoff(failovers)
                     continue
@@ -1084,11 +1329,21 @@ class FleetRouter:
 
     # -- observability plane ---------------------------------------------------
     def owner_of(self, request_id: str) -> str | None:
+        """Final serving replica of a tracked request — the last
+        request-plane hop's replica. The full replica set lives in
+        participants_of; this keeps the old owner-map contract for
+        bench/operator callers."""
         with self._lock:
-            return self._owners.get(request_id)
+            rec = self._participants.get(request_id)
+            if rec is None:
+                return None
+            for h in reversed(rec["hops"]):
+                if h.get("hop") in ("route", "stream", "failover", "hedge"):
+                    return h.get("replica") or None
+            return rec["replicas"][-1] if rec["replicas"] else None
 
-    def timeline(self, request_id: str) -> dict[str, Any] | None:
-        """Request-id pass-through: forward to the owning replica so
+    def _timeline_single(self, request_id: str) -> dict[str, Any] | None:
+        """Single-replica pass-through: forward to the owning replica so
         ``opsagent timeline`` / GET /api/timeline work through the
         router instead of 404ing. Unknown owners fall back to asking
         every live replica (the id may predate a router restart)."""
@@ -1111,6 +1366,115 @@ class FleetRouter:
                 tl["replica"] = info.replica_id
                 return tl
         return None
+
+    def timeline(self, request_id: str) -> dict[str, Any] | None:
+        """Fleet-scope timeline: ask EVERY replica the participants map
+        recorded for the journey, stitch their per-replica timelines
+        (skew-corrected by the heartbeat clock-offset estimates) with
+        the router-side routing/failover/hedge/fault-in windows into one
+        multi-lane view. Requests without a journey record (journeys
+        off, or pre-restart ids) degrade to the single-replica
+        pass-through; reaped participants degrade to the survivors."""
+        rec = self.participants_of(request_id)
+        if rec is None:
+            return self._timeline_single(request_id)
+        sources: dict[str, dict[str, Any]] = {}
+        reaped: list[str] = []
+        shared_done = False
+        for rid in rec["replicas"] or [None]:
+            if rid is None:
+                break
+            info = self.registry.get(rid)
+            if info is None or info.handle is None:
+                reaped.append(rid)
+                continue
+            if isinstance(info.handle, LocalReplica):
+                # In-process replicas share one trace store: assemble
+                # the journey trace once under the shared lane and let
+                # the stitcher attribute segments to replica lanes via
+                # the trace's fleet legs.
+                if not shared_done:
+                    tl = obs.timeline.assemble(request_id)
+                    if tl is not None:
+                        sources["_shared"] = tl
+                    shared_done = True
+                continue
+            try:
+                tl = info.handle.timeline(request_id)
+            except Exception:  # noqa: BLE001 - participant unreachable
+                reaped.append(rid)
+                continue
+            if tl is not None:
+                sources[rid] = tl
+        if not sources:
+            return self._timeline_single(request_id)
+        try:
+            events = self.fleet_flight(
+                n=0, request_id=request_id
+            ).get("events", [])
+        except Exception:  # noqa: BLE001 - events only enrich windows
+            events = []
+        out = obs.timeline.stitch_fleet(
+            request_id, sources, journey=rec,
+            offsets=self.registry.clock_offsets(),
+            reaped=reaped, events=events,
+        )
+        # Single-replica callers keep reading tl["replica"]: the final
+        # serving replica (the full set is in tl["replicas"]).
+        out["replica"] = self.owner_of(request_id) or ""
+        return out
+
+    def fleet_flight(
+        self, n: int = 256, kind: str = "", request_id: str = "",
+    ) -> dict[str, Any]:
+        """GET /api/fleet/flight: every replica's flight ring merged
+        into one replica-tagged, skew-corrected, time-ordered ledger.
+        The router's own process ring is included once (it also covers
+        all in-process replicas — they share it); remote replicas are
+        polled over HTTP. ``request_id`` filters to one journey's
+        events; ``n`` caps the merged tail (0 = no cap)."""
+        offsets = self.registry.clock_offsets()
+        merged: list[dict[str, Any]] = []
+        replicas: list[str] = []
+
+        def _ingest(evs: list[dict[str, Any]], src: str, off: float):
+            for e in evs:
+                if not isinstance(e, dict):
+                    continue
+                if request_id and e.get("request_id") != request_id:
+                    continue
+                e = dict(e)
+                e.setdefault("replica", src)
+                e["source"] = src
+                if "wall" in e:
+                    e["wall_corrected"] = e["wall"] - off
+                merged.append(e)
+
+        _ingest(
+            obs.flight.get_recorder().snapshot(kind=kind or None),
+            "router", 0.0,
+        )
+        for info in self.registry.alive(admitting=False):
+            replicas.append(info.replica_id)
+            if info.handle is None or isinstance(info.handle, LocalReplica):
+                continue   # local replicas share the router's ring
+            try:
+                evs = info.handle.flight(
+                    n=2048 if request_id else n, kind=kind
+                )
+            except Exception:  # noqa: BLE001 - degrade to survivors
+                continue
+            _ingest(evs, info.replica_id, offsets.get(info.replica_id, 0.0))
+        merged.sort(
+            key=lambda e: e.get("wall_corrected", e.get("wall", 0.0))
+        )
+        if n and not request_id:
+            merged = merged[-n:]
+        return {
+            "replicas": replicas,
+            "clock_offset_s": offsets,
+            "events": merged,
+        }
 
     def slo_aggregate(self) -> dict[str, Any]:
         """Fleet-wide /api/slo: every replica's verdicts concatenated
@@ -1158,7 +1522,7 @@ class FleetRouter:
                 row["slo"] = {"pass": None, "error": "unreachable"}
         with self._lock:
             snap["pinned_sessions"] = len(self._pins)
-            snap["tracked_requests"] = len(self._owners)
+            snap["tracked_requests"] = len(self._participants)
         return snap
 
     def bench_rows(self) -> list[dict[str, Any]]:
@@ -1297,7 +1661,7 @@ def build_router_app(router: FleetRouter):
             "prefill_lanes": sum(
                 1 for r in replicas if r.role == "prefill"
             ),
-            "health": router.registry.health_snapshot(),
+            "health": router.registry.health_snapshot(clock=True),
             "queued": sum(r.queue_depth() for r in replicas),
             "shed_queue_depth": router.shed_queue_depth,
             "directory": router.registry.directory.stats(),
@@ -1366,6 +1730,9 @@ def build_router_app(router: FleetRouter):
         return web.json_response({
             "status": "registered", "replica_id": rid,
             "heartbeat_ttl_s": router.registry.ttl_s,
+            # Echoed back on the next heartbeat so the registry can
+            # estimate this replica's clock offset and RTT.
+            "router_ts": time.time(),
         })
 
     async def heartbeat(request: web.Request) -> web.Response:
@@ -1380,6 +1747,9 @@ def build_router_app(router: FleetRouter):
             load=body.get("load"),
             digests=body.get("digests"),
             digest_truncated=body.get("digest_truncated"),
+            replica_ts=body.get("replica_ts"),
+            echo_router_ts=body.get("echo_router_ts"),
+            echo_held_s=body.get("echo_held_s"),
         )
         if not ok:
             # 410: the replica was reaped (or the router restarted) — it
@@ -1388,7 +1758,7 @@ def build_router_app(router: FleetRouter):
                 {"error": {"message": "unknown replica; re-register"}},
                 status=410,
             )
-        return web.json_response({"status": "ok"})
+        return web.json_response({"status": "ok", "router_ts": time.time()})
 
     async def directory_lookup(request: web.Request) -> web.Response:
         # Fleet-global KV: a replica that missed locally asks which
@@ -1457,6 +1827,17 @@ def build_router_app(router: FleetRouter):
 
         return web.json_response(await _exec(_snap))
 
+    async def fleet_flight_get(request: web.Request) -> web.Response:
+        try:
+            n = int(request.query.get("n", 256))
+        except ValueError:
+            n = 256
+        kind = request.query.get("kind", "")
+        rid = request.query.get("request_id", "")
+        return web.json_response(await _exec(
+            lambda: router.fleet_flight(n=n, kind=kind, request_id=rid)
+        ))
+
     async def deregister(request: web.Request) -> web.Response:
         try:
             body = await request.json()
@@ -1486,6 +1867,7 @@ def build_router_app(router: FleetRouter):
     app.router.add_get("/api/fleet", fleet_get)
     app.router.add_get("/api/fleet/bench", fleet_bench)
     app.router.add_get("/api/fleet/directory", directory_get)
+    app.router.add_get("/api/fleet/flight", fleet_flight_get)
     app.router.add_get("/api/timeline/{request_id}", timeline_get)
     app.router.add_post("/fleet/register", register)
     app.router.add_post("/fleet/heartbeat", heartbeat)

@@ -158,7 +158,7 @@ def records_nbytes(records: list[dict[str, Any]]) -> int:
 
 def migrate_chain(
     src: Any, dst: Any, token_ids: list[int], reason: str,
-    session: str = "", park: bool = True,
+    session: str = "", park: bool = True, request_id: str = "",
 ) -> int:
     """Ship one token chain's KV pages from replica ``src`` to replica
     ``dst`` (both ReplicaHandle, serving/fleet/router.py). With ``park``
@@ -166,13 +166,16 @@ def migrate_chain(
     pool (Engine.park_chain) — required when the pages are still
     trie-resident; already-parked chains export directly. Returns pages
     shipped (0 on any failure — migration is an optimization layered on
-    a correct re-prefill fallback, so it never raises into routing)."""
+    a correct re-prefill fallback, so it never raises into routing).
+    ``request_id`` tags the flight events with the journey this transfer
+    serves, so the fleet timeline stitcher can attribute the window."""
     t0 = time.perf_counter()
+    rid_field = {"request_id": request_id} if request_id else {}
     obs.flight.record(
         "session_migrate", phase="enter", reason=reason, session=session,
         src=getattr(src, "replica_id", "?"),
         dst=getattr(dst, "replica_id", "?"),
-        tokens=len(token_ids),
+        tokens=len(token_ids), **rid_field,
     )
     pages = 0
     nbytes = 0
@@ -191,11 +194,13 @@ def migrate_chain(
         obs.FLEET_TRANSFER_PAGES.inc(pages)
         obs.FLEET_TRANSFER_BYTES.inc(nbytes)
         obs.FLEET_TRANSFER_SECONDS.observe(dt)
+    if request_id:
+        obs.FLEET_HOP_SECONDS.observe(dt, hop="migrate")
     obs.flight.record(
         "session_migrate", phase="exit", reason=reason, session=session,
         src=getattr(src, "replica_id", "?"),
         dst=getattr(dst, "replica_id", "?"),
         pages=pages, bytes=nbytes, ms=round(dt * 1e3, 3),
-        **({"error": err} if err else {}),
+        **({"error": err} if err else {}), **rid_field,
     )
     return pages

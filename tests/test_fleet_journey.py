@@ -1,0 +1,531 @@
+"""Fleet-scope request journeys (router ID propagation + stitched
+cross-replica timelines + fleet flight ledger).
+
+The acceptance gate (ISSUE 16): a streamed request that crosses replicas
+through a forced mid-SSE failover AND a pagestore peer fault-in yields
+ONE stitched timeline from the router — segments from at least two
+replicas plus the router-side failover and fault-in windows, >= 95% of
+the journey wall-time covered by attributed segments, and a monotonic,
+non-overlapping segment ordering after clock-skew correction — with
+byte-identical client output and zero post-warmup compiles.
+"""
+
+import time
+
+import jax.numpy as jnp
+
+from opsagent_tpu import obs
+from opsagent_tpu.obs import timeline as obs_timeline
+from opsagent_tpu.serving import faults
+from opsagent_tpu.serving.api import ServingStack
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.fleet.registry import (
+    ClockSync,
+    ReplicaInfo,
+    ReplicaRegistry,
+)
+from opsagent_tpu.serving.fleet.router import FleetRouter
+
+BASE = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=4,
+    num_pages=256, max_pages_per_seq=64, max_batch_size=4,
+    prefill_buckets=(16, 32, 64), decode_block=4, seed=0,
+    offload=True,
+)
+
+
+def _fleet(n=2, **router_kw):
+    router = FleetRouter(**router_kw)
+    stacks = []
+    for i in range(n):
+        stack = ServingStack(Engine(EngineConfig(**BASE)))
+        stacks.append(stack)
+        router.add_local(stack, f"r{i}")
+    return router, stacks
+
+
+def _close(stacks):
+    for s in stacks:
+        s.close()
+
+
+# -- S2: heartbeat clock sync -------------------------------------------------
+class TestClockSync:
+    def test_ewma_first_sample_snaps_then_smooths(self):
+        c = ClockSync()
+        c.update(0.5, 0.02)
+        assert c.offset_s == 0.5 and c.rtt_s == 0.02 and c.samples == 1
+        c.update(1.5, 0.04)
+        # EWMA alpha=0.3: 0.5 + 0.3 * (1.5 - 0.5) = 0.8
+        assert abs(c.offset_s - 0.8) < 1e-9
+        assert abs(c.rtt_s - 0.026) < 1e-9
+
+    def test_heartbeat_echo_estimates_synthetic_skew(self):
+        """A replica whose wall clock runs 42s ahead of the router's:
+        the echo protocol recovers the offset to within the RTT."""
+        skew = 42.0
+        reg = ReplicaRegistry()
+        reg.register(ReplicaInfo(replica_id="remote", url="http://x"))
+        # The replica echoes a router_ts it received `held` seconds ago
+        # (on its own monotonic clock); its wall clock reads router+skew.
+        held = 0.05
+        ok = reg.heartbeat(
+            "remote",
+            replica_ts=time.time() + skew,
+            echo_router_ts=time.time() - held,
+            echo_held_s=held,
+        )
+        assert ok
+        c = reg.clock_of("remote")
+        assert c is not None and c.samples == 1
+        assert abs(c.offset_s - skew) < 0.05
+        assert 0.0 <= c.rtt_s < 0.05
+        assert abs(reg.clock_offsets()["remote"] - skew) < 0.05
+        # The estimate reaches the metrics surface and health snapshot.
+        assert abs(
+            obs.metrics_snapshot().get(
+                'opsagent_fleet_clock_skew_seconds{replica="remote"}', 0.0
+            ) - skew
+        ) < 0.05
+        snap = reg.health_snapshot(clock=True)
+        assert abs(snap["remote"]["clock_offset_s"] - skew) < 0.05
+        assert snap["remote"]["clock_samples"] == 1
+        # Default (clock=False) keeps the legacy {rid: state} shape.
+        assert reg.health_snapshot()["remote"] in (
+            "healthy", "suspect", "ejected", "half-open"
+        )
+
+    def test_local_replicas_are_seeded_at_zero_offset(self):
+        reg = ReplicaRegistry()
+        reg.register(ReplicaInfo(replica_id="loc", local=True))
+        c = reg.clock_of("loc")
+        assert c is not None and c.samples >= 1
+        assert c.offset_s == 0.0
+        # Echo fields on a local replica never move the estimate.
+        reg.heartbeat(
+            "loc", replica_ts=time.time() + 99,
+            echo_router_ts=time.time(), echo_held_s=0.0,
+        )
+        assert reg.clock_of("loc").offset_s == 0.0
+
+    def test_deregister_drops_clock_state(self):
+        reg = ReplicaRegistry()
+        reg.register(ReplicaInfo(replica_id="gone", url="http://x"))
+        assert reg.clock_of("gone") is not None
+        reg.deregister("gone")
+        assert reg.clock_of("gone") is None
+        assert "gone" not in reg.clock_offsets()
+
+
+# -- S1: participants map -----------------------------------------------------
+class TestParticipantsMap:
+    def test_journey_records_every_hop_and_replica(self):
+        router = FleetRouter()
+        jid = router._new_journey()
+        assert jid and jid.startswith("chatcmpl-")
+        router._note_hop(jid, "r0", "stream", failovers=0)
+        router._note_hop(jid, "r1", "failover", failovers=1)
+        router._note_shape(jid, "failover")
+        rec = router.participants_of(jid)
+        assert rec["replicas"] == ["r0", "r1"]
+        assert [h["hop"] for h in rec["hops"]] == ["stream", "failover"]
+        assert rec["shape"] == "failover"
+        assert all(h["wall"] > 0 for h in rec["hops"])
+        assert router.owner_of(jid) == "r1"
+
+    def test_shape_escalates_but_never_downgrades(self):
+        router = FleetRouter()
+        jid = router._new_journey()
+        router._note_shape(jid, "failover")
+        router._note_shape(jid, "retried")
+        assert router.participants_of(jid)["shape"] == "failover"
+
+    def test_map_is_bounded_lru(self):
+        router = FleetRouter()
+        router._max_map = 8
+        jids = [router._new_journey() for _ in range(12)]
+        assert router.participants_of(jids[0]) is None
+        assert router.participants_of(jids[-1]) is not None
+        with router._lock:
+            assert len(router._participants) == 8
+
+    def test_journeys_off_mints_nothing(self):
+        router = FleetRouter(journeys=False)
+        assert router._new_journey() is None
+        router._note_hop(None, "r0", "route")      # all no-ops
+        router._note_shape(None, "failover")
+        router._finish_journey(None)
+        with router._lock:
+            assert not router._participants
+
+    def test_finish_counts_shape_exactly_once(self):
+        router = FleetRouter()
+        before = obs.FLEET_JOURNEYS.value(shape="hedged")
+        jid = router._new_journey()
+        router._note_shape(jid, "hedged")
+        router._finish_journey(jid)
+        router._finish_journey(jid)
+        assert obs.FLEET_JOURNEYS.value(shape="hedged") == before + 1
+
+
+# -- stitcher unit behavior ---------------------------------------------------
+def _mk_source(t0_wall, phases, legs=None):
+    return {
+        "request_id": "chatcmpl-x", "t0_wall": t0_wall,
+        "fleet_legs": legs or [],
+        "duration_ms": max(p[2] for p in phases),
+        "phases": [
+            {"phase": p[0], "start_ms": p[1], "end_ms": p[2],
+             "duration_ms": p[2] - p[1]}
+            for p in phases
+        ],
+        "goodput": {}, "events": [],
+    }
+
+
+class TestStitchFleet:
+    def test_skew_correction_orders_remote_segments(self):
+        """Replica B's clock runs 10s ahead; without correction its
+        segments would land far in the future. With offsets they
+        interleave correctly after A's."""
+        t0 = 1000.0
+        src_a = _mk_source(t0, [("prefill", 0.0, 40.0),
+                                ("decode", 40.0, 100.0)])
+        src_b = _mk_source(
+            t0 + 10.0 + 0.1, [("decode", 0.0, 80.0)]
+        )   # B dispatched 100ms after A, but B's wall is +10s
+        out = obs_timeline.stitch_fleet(
+            "chatcmpl-x", {"ra": src_a, "rb": src_b},
+            journey={"t0_wall": t0, "shape": "failover",
+                     "replicas": ["ra", "rb"], "hops": []},
+            offsets={"ra": 0.0, "rb": 10.0},
+        )
+        assert out["fleet"] is True
+        assert out["replicas"] == ["ra", "rb"]
+        assert out["duration_ms"] < 1000.0   # the 10s skew is gone
+        segs = out["segments"]
+        # Monotonic and non-overlapping after correction.
+        for prev, cur in zip(segs, segs[1:]):
+            assert cur["start_ms"] >= prev["end_ms"] - 1e-6
+        lanes = {s["replica"] for s in segs}
+        assert lanes == {"ra", "rb"}
+        assert out["clock_offset_ms"]["rb"] == 10000.0
+
+    def test_shared_source_splits_lanes_by_fleet_legs(self):
+        t0 = 2000.0
+        shared = _mk_source(
+            t0,
+            [("prefill", 0.0, 30.0), ("decode", 30.0, 60.0),
+             ("decode", 70.0, 120.0)],
+            legs=[
+                {"replica": "r0", "hop": "stream",
+                 "start_ms": 0.0, "end_ms": 120.0},
+                {"replica": "r1", "hop": "failover",
+                 "start_ms": 65.0, "end_ms": 120.0},
+            ],
+        )
+        out = obs_timeline.stitch_fleet(
+            "chatcmpl-x", {"_shared": shared},
+            journey={"t0_wall": t0, "shape": "failover",
+                     "replicas": ["r0", "r1"], "hops": []},
+        )
+        by_lane = {
+            r: [s["phase"] for s in segs]
+            for r, segs in out["lanes"].items()
+        }
+        # The innermost (failover) leg claims the late decode segment.
+        assert by_lane["r0"] == ["prefill", "decode"]
+        assert by_lane["r1"] == ["decode"]
+
+    def test_windows_from_flight_events_and_reaped_degrade(self):
+        t0 = 3000.0
+        src = _mk_source(t0, [("decode", 0.0, 50.0)])
+        out = obs_timeline.stitch_fleet(
+            "chatcmpl-x", {"r0": src},
+            journey={
+                "t0_wall": t0 - 0.01, "shape": "failover",
+                "replicas": ["r0", "r1"],
+                "hops": [
+                    {"hop": "stream", "replica": "r0", "wall": t0},
+                    {"hop": "failover", "replica": "r1",
+                     "wall": t0 + 0.2},
+                ],
+            },
+            reaped=["r1"],
+            events=[
+                {"kind": "failover", "wall": t0 + 0.15, "replica": "r0"},
+                {"kind": "page_fault_in", "phase": "enter",
+                 "wall": t0 + 0.21, "replica": "r1"},
+                {"kind": "page_fault_in", "phase": "exit",
+                 "wall": t0 + 0.25, "replica": "r1", "pages": 3},
+            ],
+        )
+        kinds = {w["kind"] for w in out["windows"]}
+        assert {"routing", "failover", "fault_in"} <= kinds
+        fo = next(w for w in out["windows"] if w["kind"] == "failover")
+        # The failover window runs to the next hop dispatch.
+        assert abs(fo["duration_ms"] - 50.0) < 1.0
+        fi = next(w for w in out["windows"] if w["kind"] == "fault_in")
+        assert fi["pages"] == 3
+        assert out["reaped"] == ["r1"]
+        text = obs_timeline.render_fleet_gantt(out)
+        assert "degraded" in text and "r1" in text
+        assert "fault_in" in text
+
+    def test_empty_sources_return_zeroed_shell(self):
+        out = obs_timeline.stitch_fleet("chatcmpl-x", {})
+        assert out["fleet"] is True and out["segments"] == []
+        assert out["coverage"] == 0.0
+
+
+# -- ID propagation: the engine adopts the router's journey id ----------------
+class TestIdAdoption:
+    def test_response_id_is_the_journey_id(self):
+        router, stacks = _fleet(1)
+        try:
+            resp = router.complete({
+                "messages": [{"role": "user", "content": "adopt me"}],
+                "max_tokens": 4, "temperature": 0,
+            })
+            rid = resp["id"]
+            rec = router.participants_of(rid)
+            assert rec is not None, "response id must BE the journey id"
+            assert rec["replicas"] == ["r0"]
+            assert rec["hops"][0]["hop"] == "route"
+            # The engine-side trace exists under the same id.
+            assert obs.timeline.assemble(rid) is not None
+        finally:
+            _close(stacks)
+
+    def test_journeys_off_keeps_engine_minted_ids(self):
+        router, stacks = _fleet(1, journeys=False)
+        try:
+            resp = router.complete({
+                "messages": [{"role": "user", "content": "no stamps"}],
+                "max_tokens": 4, "temperature": 0,
+            })
+            rid = resp["id"]
+            # No journey record beyond the minimal owner entry.
+            assert router.owner_of(rid) == "r0"
+            rec = router.participants_of(rid)
+            assert rec["hops"] == []
+        finally:
+            _close(stacks)
+
+    def test_hop_header_synthesis_on_the_engine_server(self):
+        """HTTP replicas receive the hop as X-Fleet-* headers when the
+        body lost the field (proxies that re-serialize): the engine
+        server synthesizes body['fleet_hop'] from them."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from opsagent_tpu.serving.api import build_engine_app
+
+        stack = ServingStack(Engine(EngineConfig(**BASE)))
+        app = build_engine_app(stack)
+
+        async def scenario():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                jid = "chatcmpl-deadbeefdeadbeefdeadbeef"
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "messages": [
+                            {"role": "user", "content": "hdr hop"}
+                        ],
+                        "max_tokens": 4, "temperature": 0,
+                    },
+                    headers={
+                        "X-Fleet-Request-Id": jid,
+                        "X-Fleet-Hop": "route",
+                        "X-Fleet-Replica": "r9",
+                    },
+                )
+                assert r.status == 200, await r.text()
+                body = await r.json()
+                assert body["id"] == jid
+            finally:
+                await client.close()
+
+        import asyncio
+
+        try:
+            asyncio.new_event_loop().run_until_complete(scenario())
+        finally:
+            stack.close()
+
+
+# -- fleet flight ledger ------------------------------------------------------
+class TestFleetFlight:
+    def test_merged_ledger_is_replica_tagged_and_ordered(self):
+        router, stacks = _fleet(2)
+        try:
+            resp = router.complete({
+                "messages": [{"role": "user", "content": "ledger"}],
+                "max_tokens": 4, "temperature": 0,
+            })
+            led = router.fleet_flight(n=0)
+            assert set(led["replicas"]) == {"r0", "r1"}
+            assert led["events"], "process ring must contribute events"
+            walls = [
+                e.get("wall_corrected", e.get("wall", 0.0))
+                for e in led["events"]
+            ]
+            assert walls == sorted(walls)
+            assert all("source" in e for e in led["events"])
+            # request_id filter narrows to the journey's events.
+            only = router.fleet_flight(request_id=resp["id"])["events"]
+            assert only
+            assert all(e["request_id"] == resp["id"] for e in only)
+        finally:
+            _close(stacks)
+
+    def test_anomaly_dump_carries_the_journey(self):
+        router, stacks = _fleet(1)
+        try:
+            resp = router.complete({
+                "messages": [{"role": "user", "content": "dump me"}],
+                "max_tokens": 4, "temperature": 0,
+            })
+            ctx = obs.flight.get_recorder()._dump_context(
+                {"request_id": resp["id"]}
+            )
+            legs = [
+                c for c in ctx if c.get("kind") == "fleet_journey"
+            ]
+            assert legs, "anomaly context must include the journey"
+            assert legs[0]["replicas"] == ["r0"]
+            assert legs[0]["hops"]
+        finally:
+            _close(stacks)
+
+
+# -- THE acceptance gate ------------------------------------------------------
+def test_failover_plus_fault_in_yields_one_stitched_timeline():
+    """Streamed request through a forced mid-SSE failover AND a
+    pagestore peer fault-in: one stitched timeline from the router with
+    segments from both replicas, router-side failover + fault-in
+    windows, >= 95% coverage, monotonic non-overlapping segments,
+    byte-identical output, zero post-warmup compiles."""
+    # Reference: the same two turns on ONE replica, fault-free.
+    ref_stack = ServingStack(Engine(EngineConfig(**BASE)))
+    try:
+        messages = [
+            {"role": "system", "content": "journey test"},
+            {"role": "user", "content": "first turn here"},
+        ]
+        r1 = ref_stack.chat_completion(
+            {"messages": messages, "max_tokens": 8, "temperature": 0}
+        )
+        turn1_text = r1["choices"][0]["message"]["content"] or ""
+        turn2_msgs = list(messages) + [
+            {"role": "assistant", "content": turn1_text},
+            {"role": "user", "content": "second turn now"},
+        ]
+        r2 = ref_stack.chat_completion(
+            {"messages": turn2_msgs, "max_tokens": 12, "temperature": 0}
+        )
+        want_turn2 = r2["choices"][0]["message"]["content"] or ""
+        assert want_turn2
+    finally:
+        ref_stack.close()
+
+    router, stacks = _fleet(2)   # pagestore directory ON by default
+    try:
+        # Turn 1 pinned to r0: the chain's pages live on r0 and are
+        # advertised through the directory.
+        resp1 = router.complete(
+            {"messages": messages, "max_tokens": 8, "temperature": 0},
+            force_replica="r0",
+        )
+        assert (resp1["choices"][0]["message"]["content"] or "") == \
+            turn1_text
+
+        # Turn 2 streamed, unforced: affinity routes to r0, the 5th
+        # chunk pull dies (injected), failover resumes on r1, whose
+        # admission faults the chain in from r0 peer-to-peer.
+        faults.configure("fleet.stream_disconnect@5")
+        chunks = list(router.complete_stream({
+            "messages": turn2_msgs, "max_tokens": 12, "temperature": 0,
+            "stream": True,
+        }))
+        faults.reset()
+        assert all("error" not in c for c in chunks), chunks
+        text = "".join(
+            c["choices"][0]["delta"].get("content") or ""
+            for c in chunks
+        )
+        assert text == want_turn2          # byte-identical across the seam
+        jid = chunks[0]["id"]
+
+        rec = router.participants_of(jid)
+        assert rec is not None and rec["shape"] == "failover"
+        assert set(rec["replicas"]) >= {"r0", "r1"}
+
+        # The pagestore fault-in ran as part of THIS journey.
+        fi = [
+            e for e in obs.flight.get_recorder().snapshot(
+                kind="page_fault_in"
+            )
+            if e.get("request_id") == jid
+        ]
+        assert any(
+            e.get("phase") == "exit" and e.get("pages", 0) > 0
+            for e in fi
+        ), fi
+
+        # ONE stitched timeline from the router.
+        tl = router.timeline(jid)
+        assert tl is not None and tl.get("fleet") is True
+        assert tl["shape"] == "failover"
+        lanes_with_segments = {
+            s["replica"] for s in tl["segments"]
+        }
+        assert len(lanes_with_segments) >= 2, tl["segments"]
+        kinds = {w["kind"] for w in tl["windows"]}
+        assert "failover" in kinds, kinds
+        assert "fault_in" in kinds, kinds
+        assert tl["coverage"] >= 0.95, (tl["coverage"], tl["windows"])
+        for prev, cur in zip(tl["segments"], tl["segments"][1:]):
+            assert cur["start_ms"] >= prev["end_ms"] - 1e-6, (prev, cur)
+        # The journey counted once under its most eventful shape.
+        assert obs.FLEET_JOURNEYS.value(shape="failover") >= 1
+        # Renderable as a multi-lane gantt with both replica lanes.
+        art = obs_timeline.render_fleet_gantt(tl)
+        assert "lane r0:" in art and "lane r1:" in art
+        assert "fault_in" in art
+        # Zero-post-warmup-compiles invariant held throughout.
+        compiles = [
+            e for e in obs.flight.get_recorder().snapshot(kind="anomaly")
+            if e.get("reason") == "post_warmup_compile"
+        ]
+        assert not compiles
+    finally:
+        faults.reset()
+        _close(stacks)
+
+
+def test_stitched_timeline_degrades_when_participant_is_reaped():
+    router, stacks = _fleet(2)
+    try:
+        faults.configure("fleet.stream_disconnect@5")
+        chunks = list(router.complete_stream({
+            "messages": [{"role": "user", "content": "reap test"}],
+            "max_tokens": 12, "temperature": 0, "stream": True,
+        }))
+        faults.reset()
+        jid = chunks[0]["id"]
+        rec = router.participants_of(jid)
+        assert rec and len(rec["replicas"]) == 2
+        # In-process replicas share the trace store, so reaping one
+        # still leaves the shared source: the stitch must survive and
+        # stay fleet-shaped rather than 404 or raise.
+        dead = rec["replicas"][0]
+        router.registry.deregister(dead)
+        tl = router.timeline(jid)
+        assert tl is not None and tl.get("fleet") is True
+        assert tl["segments"]
+    finally:
+        faults.reset()
+        _close(stacks)

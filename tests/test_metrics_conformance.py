@@ -195,6 +195,48 @@ def test_goodput_ledger_families_on_the_scrape():
         assert f'opsagent_attr_step_bytes{{kind="{k}"}}' in text
 
 
+def test_fleet_journey_families_on_the_scrape():
+    """The fleet-journey families (ISSUE 16's contract with dashboards):
+    hop latency histogram, journey shape counter, per-replica clock-skew
+    gauge — present and typed once traffic touches them."""
+    obs.FLEET_HOP_SECONDS.observe(0.012, hop="route")
+    obs.FLEET_HOP_SECONDS.observe(0.034, hop="failover")
+    obs.FLEET_JOURNEYS.inc(shape="direct")
+    obs.FLEET_JOURNEYS.inc(shape="failover")
+    obs.FLEET_CLOCK_SKEW.set(0.004, replica="r1")
+    text = obs.metrics_text()
+    for family, kind in (
+        ("opsagent_fleet_hop_seconds", "histogram"),
+        ("opsagent_fleet_journeys_total", "counter"),
+        ("opsagent_fleet_clock_skew_seconds", "gauge"),
+    ):
+        assert f"# TYPE {family} {kind}" in text, family
+    assert 'opsagent_fleet_hop_seconds_count{hop="route"}' in text
+    assert 'opsagent_fleet_journeys_total{shape="failover"}' in text
+    assert 'opsagent_fleet_clock_skew_seconds{replica="r1"}' in text
+
+
+def test_no_metric_family_is_keyed_by_raw_request_id():
+    """Cardinality guard: request/journey IDs are unbounded, so they may
+    appear in flight events and timelines but NEVER as a label value on
+    the metrics surface — one leaked id-per-request label melts every
+    scrape. Journey traffic runs first so a regression would be ON the
+    exposition when we scan it."""
+    obs.FLEET_HOP_SECONDS.observe(0.01, hop="route")
+    obs.FLEET_JOURNEYS.inc(shape="direct")
+    _generate_traffic()
+    text = obs.metrics_text()
+    id_like = re.compile(
+        r'="(?:chatcmpl|req|cli|tl|e2e)-[0-9a-fA-F]{8,}"'
+    )
+    for ln in text.splitlines():
+        if ln.startswith("#"):
+            continue
+        assert not id_like.search(ln), (
+            f"request-id-shaped label value on the scrape: {ln!r}"
+        )
+
+
 def test_escaped_label_values_roundtrip():
     """The escaper's output must re-parse to the original value."""
     from opsagent_tpu.obs.metrics import escape_label_value
