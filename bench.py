@@ -557,6 +557,16 @@ def run_orchestrated() -> None:
          "OPSAGENT_BENCH_MODEL": "bench-1b"},
         230, "fleet-journey",
     ) if on_tpu else None
+    # Telemetry-history overhead A/B + downsample-tier proof: the
+    # background sampler at 10x its production rate on vs off on the
+    # sessions workload (byte-identical outputs, <= 2% tok/s), plus the
+    # synthetic 90-min clock walk proving the 1s/10s/60s tiers and the
+    # ring byte bound.
+    robsh = stage(
+        {"OPSAGENT_BENCH_MODE": "obs-history",
+         "OPSAGENT_BENCH_MODEL": "bench-1b"},
+        230, "obs-history",
+    ) if on_tpu else None
     # The literal north-star metric (BASELINE: p50 TTFT per tool-call
     # turn): multi-turn ReAct-shaped sessions with the prefix cache on.
     # Reports ms, not tok/s — never a headline candidate; folded into
@@ -737,6 +747,19 @@ def run_orchestrated() -> None:
         extra["fleet_journey_off_tok_s"] = je.get("journeys_off_tok_s")
         extra["fleet_journey_smoke_ok"] = je.get("smoke_ok")
         extra["fleet_journey_smoke_coverage"] = je.get("smoke_coverage")
+    if robsh is not None:
+        he = robsh.get("extra", {})
+        extra["obs_history_overhead_pct"] = robsh["value"]
+        extra["obs_history_on_tok_s_chip"] = he.get(
+            "sampler_on_tok_s_chip"
+        )
+        extra["obs_history_off_tok_s_chip"] = he.get(
+            "sampler_off_tok_s_chip"
+        )
+        extra["obs_history_outputs_identical"] = he.get(
+            "outputs_identical"
+        )
+        extra["obs_history_tiers_ok"] = (he.get("tiers") or {}).get("ok")
     if rfgkv is not None:
         ge = rfgkv.get("extra", {})
         extra["fleet_global_kv_remote_hit_pages"] = ge.get(
@@ -817,7 +840,7 @@ def run_orchestrated() -> None:
     exit_if_perf_regression([
         r1, r8b, r8b4, r8bkv, r8b4kv, rsess, rsessmix, rsessasync,
         rsessoff, rfleet, rchaos, rfgkv, ragent, rconvey, rdma, rdmakv,
-        rcold, rcoldstart, rspec, *sweep_rows,
+        rcold, rcoldstart, rspec, robsh, *sweep_rows,
     ])
 
 
@@ -874,7 +897,7 @@ def run_single() -> None:
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "sessions-ffwd", "fleet-affinity",
                 "fleet-chaos", "fleet-global-kv", "fleet-journey",
-                "cold-start"):
+                "obs-history", "cold-start"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -990,7 +1013,8 @@ def run_single() -> None:
     t0 = time.perf_counter()
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "sessions-ffwd", "fleet-affinity",
-                "fleet-chaos", "fleet-global-kv", "fleet-journey"):
+                "fleet-chaos", "fleet-global-kv", "fleet-journey",
+                "obs-history"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -1035,6 +1059,10 @@ def run_single() -> None:
     if mode == "fleet-journey":
         run_fleet_journey(eng, cfg, model, batch, steps, prompt_len,
                           platform, n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "obs-history":
+        run_obs_history(eng, model, batch, steps, prompt_len, platform,
+                        n_chips, quantize, init_s, warmup_s)
         return
     if mode == "agent":
         # turns/gen_tokens are THE values the page-budget guard above was
@@ -2718,6 +2746,196 @@ def run_fleet_journey(eng, cfg, model, batch, steps, prompt_len, platform,
     if not smoke_ok:
         raise SystemExit("bench: fleet-journey stitched-timeline smoke "
                          "failed (see log above)")
+    exit_if_slo_breach(slo_verdicts())
+
+
+def _verify_history_tiers() -> dict:
+    """Walk a synthetic 90-minute clock through TelemetryHistory (no
+    sleeping, no engine): prove the 1 s / 10 s / 60 s downsample tiers,
+    exact counter-delta conservation across rollups (rates stay true at
+    every tier), and — in a second tiny-budget pass — that the ring's
+    byte bound actually evicts. Returns the verdict dict folded into the
+    stage's extras; ``ok`` gates the stage exit code."""
+    from opsagent_tpu.obs.history import TIER_SPECS, TelemetryHistory
+
+    total = [0.0]
+    gauge_val = [0.0]
+    step_inc = 7.0
+    n_sweeps = 90 * 60
+    t0 = 1_700_000_000.0
+
+    def walk(h) -> float:
+        total[0] = 0.0
+        for i in range(n_sweeps):
+            total[0] += step_inc
+            gauge_val[0] = float(i % 32)
+            h.sample(now=t0 + i)
+        return t0 + n_sweeps - 1
+
+    # Pass 1: generous budget — no eviction, so conservation is exact.
+    h = TelemetryHistory(max_bytes=8 * 1024 * 1024, interval_s=1.0)
+    h.register("tokens", "counter", lambda: total[0])
+    h.register("occupancy", "gauge", lambda: gauge_val[0])
+    now = walk(h)
+    st = h.stats()
+    per_tier = st["points_per_tier"]
+    # Tier shape: the fine tier only spans its horizon; the coarse tiers
+    # hold the rest (2 series share each tier count).
+    fine_ok = per_tier[0] <= 2 * (TIER_SPECS[0][1] + TIER_SPECS[1][0])
+    spread_ok = per_tier[1] > 0 and per_tier[2] > 0
+    q = h.query(series=["tokens"], since=n_sweeps + 60.0, now=now)
+    pts = q["series"]["tokens"]["points"]
+    # First sweep has no interval to delta over: n_sweeps - 1 deltas.
+    want_total = step_inc * (n_sweeps - 1)
+    conserved = abs(sum(p[1] for p in pts) - want_total) < 1e-6
+    # Re-bucketed to 60 s, interior buckets must carry exactly 60 deltas.
+    q60 = h.query(
+        series=["tokens"], since=n_sweeps + 60.0, step=60.0, now=now
+    )
+    mid = q60["series"]["tokens"]["points"][2:-2]
+    step60_ok = bool(mid) and all(
+        abs(p[1] - 60 * step_inc) < 1e-6 for p in mid
+    )
+    rate = h.rate("tokens", window_s=3600.0, now=now)
+    rate_ok = rate is not None and abs(rate - step_inc) < 0.05
+    # Pass 2: a budget far below the walk's footprint must evict — and
+    # the resident estimate must stay under it.
+    h2 = TelemetryHistory(max_bytes=16 * 1024, interval_s=1.0)
+    h2.register("tokens", "counter", lambda: total[0])
+    h2.register("occupancy", "gauge", lambda: gauge_val[0])
+    walk(h2)
+    st2 = h2.stats()
+    bound_ok = st2["evicted"] > 0 and st2["bytes"] <= st2["max_bytes"]
+    return {
+        "ok": all(
+            (fine_ok, spread_ok, conserved, step60_ok, rate_ok, bound_ok)
+        ),
+        "fine_tier_bounded": fine_ok,
+        "coarse_tiers_populated": spread_ok,
+        "deltas_conserved": conserved,
+        "step60_exact": step60_ok,
+        "rate_1h": None if rate is None else round(rate, 4),
+        "rate_ok": rate_ok,
+        "byte_bound_ok": bound_ok,
+        "bounded_bytes": st2["bytes"],
+        "bounded_evicted": st2["evicted"],
+        "points_per_tier": per_tier,
+    }
+
+
+def run_obs_history(eng, model, batch, steps, prompt_len, platform,
+                    n_chips, quantize, init_s, warmup_s) -> None:
+    """The telemetry-history overhead stage (ISSUE 18): the concurrent
+    streamed sessions workload with the background history sampler ON
+    (at 10x the production 1 Hz rate, so the bound is conservative) then
+    OFF, same prompt seeds — byte-identical outputs are the correctness
+    half, and a shared warmup drive pre-populates the prefix cache so
+    neither phase rides a cache advantage. Overhead must be <= 2 % tok/s.
+    The synthetic-clock tier walk (_verify_history_tiers) rides along as
+    the downsampling/byte-bound proof."""
+    from opsagent_tpu import obs
+    from opsagent_tpu.serving.api import ServingStack
+
+    tiers = _verify_history_tiers()
+    log(f"bench[obs-history/tiers]: ok={tiers['ok']} "
+        f"rate_1h={tiers['rate_1h']} "
+        f"bounded_bytes={tiers['bounded_bytes']} "
+        f"evicted={tiers['bounded_evicted']}")
+
+    gen_tokens = max(16, steps // 8)
+    rounds = 3
+    seed = 41000
+    h = obs.history.get_history()
+    sampler_interval_s = 0.1
+    stack = ServingStack(eng)
+    phases: dict[str, dict] = {}
+    try:
+        # Discarded warmup drive, SAME seeds as the measured phases: it
+        # absorbs lazy-init costs AND leaves the prefix cache warm for
+        # both phases equally (temperature 0 makes the grown histories
+        # identical), so the A/B delta isolates the sampler.
+        _drive_sessions_streaming(
+            stack, batch, rounds, gen_tokens, prompt_len, seed
+        )
+        for tag in ("on", "off"):
+            if tag == "on":
+                h.interval_s = sampler_interval_s
+                h.start()
+            get_perf_stats().reset()
+            try:
+                phases[tag] = _drive_sessions_streaming(
+                    stack, batch, rounds, gen_tokens, prompt_len, seed
+                )
+            finally:
+                if tag == "on":
+                    h.stop()
+                    h.interval_s = float(
+                        os.environ.get("OPSAGENT_HISTORY_INTERVAL_S", "")
+                        or 1.0
+                    )
+            r = phases[tag]
+            r["tok_s_chip"] = (
+                r["produced"] / max(1e-9, r["wall"]) / n_chips
+            )
+            log(f"bench[obs-history/{tag}]: {batch} sessions x {rounds} "
+                f"rounds, {r['produced']} tokens in {r['wall']:.2f}s -> "
+                f"{r['tok_s_chip']:.0f} tok/s/chip; "
+                f"errors={len(r['errors'])}")
+    finally:
+        stack.close()
+    hist_stats = h.stats()
+    on, off = phases["on"], phases["off"]
+    overhead_pct = (
+        (off["tok_s_chip"] - on["tok_s_chip"]) / off["tok_s_chip"] * 100.0
+        if off["tok_s_chip"] > 0 else 0.0
+    )
+    identical = (
+        on["texts"] == off["texts"]
+        and not on["errors"] and not off["errors"]
+    )
+    live_bound_ok = hist_stats["bytes"] <= hist_stats["max_bytes"]
+    ok = (
+        tiers["ok"] and identical and live_bound_ok
+        and overhead_pct <= 2.0
+    )
+    qtag = f",{quantize}" if quantize else ""
+    print(json.dumps({
+        "metric": f"obs_history[{model}{qtag},N={batch},{platform}]",
+        "value": round(overhead_pct, 2),
+        "unit": "overhead_pct",
+        "vs_baseline": None,
+        "extra": {
+            "sessions": batch,
+            "rounds": rounds,
+            "sampler_on_tok_s_chip": round(on["tok_s_chip"], 1),
+            "sampler_off_tok_s_chip": round(off["tok_s_chip"], 1),
+            "sampler_interval_s": sampler_interval_s,
+            "sampler_samples": hist_stats["samples"],
+            "history_series": hist_stats["series"],
+            "history_bytes": hist_stats["bytes"],
+            "history_max_bytes": hist_stats["max_bytes"],
+            "live_byte_bound_ok": live_bound_ok,
+            "outputs_identical": identical,
+            "tiers": tiers,
+            "errors": len(on["errors"]) + len(off["errors"]),
+            "init_s": round(init_s, 1),
+            "warmup_s": round(warmup_s, 1),
+            "chips": n_chips,
+            "platform": platform,
+            **eng.impl_info(),
+            "paged_backend": eng.attn_impl,
+            "metrics": metrics_snapshot(),
+            "attribution": attribution_snapshot(),
+            "slo": slo_verdicts(),
+        },
+    }), flush=True)
+    log_perf_table()
+    if not ok:
+        raise SystemExit(
+            f"bench: obs-history smoke failed (tiers_ok={tiers['ok']} "
+            f"identical={identical} live_bound={live_bound_ok} "
+            f"overhead={overhead_pct:.2f}% > 2%)"
+        )
     exit_if_slo_breach(slo_verdicts())
 
 

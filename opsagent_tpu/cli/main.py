@@ -94,6 +94,35 @@ def build_parser() -> argparse.ArgumentParser:
         help="BENCH json/jsonl file; reads the extra.slo verdicts "
              "bench.py folded in",
     )
+    sc.add_argument(
+        "--class", dest="slo_class", default="",
+        choices=["", "interactive", "batch", "background"],
+        help="gate one SLO class's attainment/burn (from the per-class "
+             "report) instead of the global verdicts",
+    )
+
+    tp = sub.add_parser(
+        "top",
+        help="live fleet cockpit: replica table, per-class SLO rows "
+             "with history sparklines, anomaly tail (ANSI, no curses)",
+    )
+    tp.add_argument(
+        "--url", default="http://127.0.0.1:8090",
+        help="base URL of a fleet router (or a single engine/agent "
+             "server — the replica table degrades gracefully)",
+    )
+    tp.add_argument(
+        "--interval", type=float, default=2.0,
+        help="seconds between frames",
+    )
+    tp.add_argument(
+        "--frames", type=int, default=0,
+        help="render N frames then exit (0 = until interrupted)",
+    )
+    tp.add_argument(
+        "--no-color", action="store_true", default=False,
+        help="disable ANSI colors even on a TTY",
+    )
 
     pc = sub.add_parser(
         "perf-check",
@@ -465,7 +494,19 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "slo-check":
         from .slocheck import run_slo_check
 
-        return run_slo_check(url=args.url, bench=args.bench)
+        return run_slo_check(
+            url=args.url, bench=args.bench, slo_class=args.slo_class
+        )
+
+    if args.command == "top":
+        from .top import run_top
+
+        return run_top(
+            args.url,
+            interval_s=args.interval,
+            frames=args.frames,
+            color=False if args.no_color else None,
+        )
 
     if args.command == "perf-check":
         from .perfcheck import run_perf_check

@@ -99,7 +99,7 @@ def _higher_better(unit: str) -> bool:
     u = (unit or "").lower()
     if u in (
         "ms", "s", "seconds", "failed_requests", "errors",
-        "request_ready_s", "ms/turn",
+        "request_ready_s", "ms/turn", "overhead_pct",
     ):
         return False
     return True  # tok/s/chip and friends

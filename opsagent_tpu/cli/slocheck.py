@@ -79,7 +79,68 @@ def _format(verdicts: dict[str, Any]) -> str:
     return "\n".join(rows)
 
 
-def run_slo_check(url: str = "", bench: str = "") -> int:
+def _format_class(c: dict[str, Any]) -> str:
+    rows = [
+        f"{'window':<10} {'requests':>9} {'attainment':>11} {'burn':>7}"
+    ]
+    att = c.get("attainment")
+    rows.append(
+        f"{'overall':<10} {c.get('requests', 0):>9} "
+        f"{(f'{att * 100:.2f}%' if att is not None else '-'):>11} "
+        f"{'-':>7}"
+    )
+    for name, w in (c.get("windows") or {}).items():
+        watt = w.get("attainment")
+        burn = w.get("burn_rate")
+        rows.append(
+            f"{name:<10} {w.get('requests', 0):>9} "
+            f"{(f'{watt * 100:.2f}%' if watt is not None else '-'):>11} "
+            f"{(f'{burn:.2f}' if burn is not None else '-'):>7}"
+        )
+    return "\n".join(rows)
+
+
+def _check_class(verdicts: dict[str, Any], slo_class: str) -> int:
+    """Gate one SLO class: breach when the class burns its error budget
+    faster than 1x in any history window (or its overall attainment is
+    under target). Exit codes match the global gate: 0/1/2."""
+    classes = {
+        c.get("class"): c for c in verdicts.get("classes", [])
+    }
+    c = classes.get(slo_class)
+    if c is None or not c.get("requests"):
+        print(
+            f"slo-check: class {slo_class!r} has no traffic yet",
+            file=sys.stderr,
+        )
+        return 2
+    print(f"slo-check: class {slo_class}")
+    print(_format_class(c))
+    burned = [
+        name
+        for name, w in (c.get("windows") or {}).items()
+        if (w.get("burn_rate") or 0.0) > 1.0 and w.get("requests")
+    ]
+    att = c.get("attainment")
+    target = 1.0 - float(verdicts.get("error_budget", 0.01) or 0.01)
+    if burned:
+        print(
+            f"slo-check: BREACH: class {slo_class} burn>1x over "
+            f"{', '.join(sorted(burned))}",
+            file=sys.stderr,
+        )
+        return 1
+    if att is not None and att < target:
+        print(
+            f"slo-check: BREACH: class {slo_class} attainment "
+            f"{att * 100:.2f}% < {target * 100:.2f}%",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def run_slo_check(url: str = "", bench: str = "", slo_class: str = "") -> int:
     try:
         if url:
             verdicts = _fetch_url(url)
@@ -98,6 +159,8 @@ def run_slo_check(url: str = "", bench: str = "") -> int:
     except Exception as e:  # noqa: BLE001 - CI gate: report, exit 2
         print(f"slo-check: unavailable: {e}", file=sys.stderr)
         return 2
+    if slo_class:
+        return _check_class(verdicts, slo_class)
     fleet = verdicts.get("fleet")
     if fleet:
         print(

@@ -320,7 +320,8 @@ FLEET_RETRIES = _reg.counter(
 FLEET_HEDGES = _reg.counter(
     "opsagent_fleet_hedges_total",
     "TTFT hedges: a queued cold admission raced on a second replica, "
-    "first completion wins",
+    "first completion wins; labeled by the request's SLO class",
+    labelnames=("class",),
 )
 FLEET_EJECTIONS = _reg.counter(
     "opsagent_fleet_ejections_total",
@@ -330,7 +331,8 @@ FLEET_EJECTIONS = _reg.counter(
 FLEET_SHED = _reg.counter(
     "opsagent_fleet_shed_total",
     "Requests shed by router admission control above the overload "
-    "watermark (429 + Retry-After)",
+    "watermark (429 + Retry-After), by SLO class of the shed request",
+    labelnames=("class",),
 )
 FLEET_REPLICA_HEALTH = _reg.gauge(
     "opsagent_fleet_replica_health",
@@ -360,8 +362,9 @@ FLEET_JOURNEYS = _reg.counter(
     "Completed fleet request journeys by shape (direct = one replica "
     "start to finish, retried = connect-phase re-route, hedged = a "
     "backup probe raced, failover = resumed on a survivor mid-request; "
-    "a journey counts once under its most eventful shape)",
-    labelnames=("shape",),
+    "a journey counts once under its most eventful shape), by shape "
+    "and SLO class",
+    labelnames=("shape", "class"),
 )
 FLEET_CLOCK_SKEW = _reg.gauge(
     "opsagent_fleet_clock_skew_seconds",
@@ -489,6 +492,63 @@ TOOL_LAUNCH_LEAD_SECONDS = _reg.histogram(
     buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
 )
 
+# -- SLO classes + telemetry history + trace retention ------------------------
+# The class enum is closed: every request is exactly one of these, and
+# the metrics-conformance cardinality guard rejects any other value on
+# the scrape (free-form class labels would melt it like request ids).
+SLO_CLASSES = ("interactive", "batch", "background")
+CLASS_REQUESTS = _reg.counter(
+    "opsagent_class_requests_total",
+    "Requests by SLO class and outcome (completed / error / timeout / "
+    "admission_failed / shed) — the per-class attainment numerator and "
+    "denominator",
+    labelnames=("class", "outcome"),
+)
+CLASS_TTFT_SECONDS = _reg.histogram(
+    "opsagent_class_ttft_seconds",
+    "Time to first token per admitted request, split by SLO class "
+    "(the unlabeled opsagent_ttft_seconds stays the all-traffic view)",
+    labelnames=("class",),
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0),
+)
+CLASS_ITL_SECONDS = _reg.histogram(
+    "opsagent_class_itl_seconds",
+    "Inter-token latency split by SLO class",
+    labelnames=("class",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5),
+)
+CLASS_GOODPUT_SECONDS = _reg.counter(
+    "opsagent_class_goodput_seconds_total",
+    "Request wall seconds by SLO class and goodput phase (the per-class "
+    "split of opsagent_goodput_seconds_total)",
+    labelnames=("class", "phase"),
+)
+TRACE_RETENTION = _reg.counter(
+    "opsagent_trace_retention_total",
+    "Tail-based trace retention decisions at request finish "
+    "(kept_anomalous = SLO breach/error/failover, always kept; "
+    "kept_sampled = healthy, won the sample draw; dropped = healthy, "
+    "lost it)",
+    labelnames=("decision",),
+)
+HISTORY_SAMPLES = _reg.counter(
+    "opsagent_history_samples_total",
+    "Sampling sweeps the telemetry history store has taken",
+)
+HISTORY_POINTS = _reg.gauge(
+    "opsagent_history_points",
+    "Points resident in the telemetry history ring, by downsample tier "
+    "(1s / 10s / 60s)",
+    labelnames=("tier",),
+)
+HISTORY_BYTES = _reg.gauge(
+    "opsagent_history_bytes",
+    "Estimated resident bytes of the telemetry history ring (bounded "
+    "by OPSAGENT_HISTORY_BYTES)",
+)
+
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
@@ -515,6 +575,7 @@ from . import flight  # noqa: E402,F401
 from . import slo  # noqa: E402,F401
 from . import attribution  # noqa: E402,F401
 from . import timeline  # noqa: E402,F401
+from . import history  # noqa: E402,F401
 
 flight.install_compile_watchdog()
 _reg.add_collector(lambda: slo.get_watchdog().collect())

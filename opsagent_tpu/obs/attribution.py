@@ -422,11 +422,21 @@ def record_copy(nbytes: float, direction: str, seconds: float | None = None) -> 
         pass
 
 
-def record_goodput(seconds: float, phase: str) -> None:
+def record_goodput(seconds: float, phase: str, slo_class: str = "") -> None:
     """Accumulate request wall seconds into the goodput split. Phases:
-    queued / prefill / decode_active / tool_blocked. Never raises."""
+    queued / prefill / decode_active / tool_blocked. When the caller
+    knows the request's SLO class the same seconds also land in the
+    per-class split (opsagent_class_goodput_seconds_total), so "did
+    goodput degrade by class during that burst?" is answerable. Never
+    raises."""
     try:
         if seconds > 0:
             GOODPUT_SECONDS.inc(float(seconds), phase=phase)
+            if slo_class:
+                from . import CLASS_GOODPUT_SECONDS
+
+                CLASS_GOODPUT_SECONDS.inc(
+                    float(seconds), **{"class": slo_class, "phase": phase}
+                )
     except Exception:  # noqa: BLE001
         pass

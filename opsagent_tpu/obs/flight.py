@@ -145,6 +145,16 @@ class FlightRecorder:
                 ANOMALIES.inc(reason=reason)
             except Exception:  # noqa: BLE001
                 pass
+        # Anomalies pin traces: tail-based retention must keep the trace
+        # of any request that breached/errored, and every per-request
+        # anomaly caller already passes request_id here — one hook covers
+        # ttft_breach, request_error, admission_failed, and slo_breach.
+        try:
+            from . import trace as _trace
+
+            _trace.mark_anomalous(fields.get("request_id"), reason=reason)
+        except Exception:  # noqa: BLE001
+            pass
         now = time.perf_counter()
         with self._lock:
             if now - self._last_dump_s < self.dump_interval_s:
@@ -201,6 +211,18 @@ class FlightRecorder:
             out.append({
                 "kind": "attribution_snapshot", **attribution.snapshot(),
             })
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from . import history as _history
+
+            # The last 60 s of every tracked series at the 1 s tier: the
+            # lead-up to the anomaly (goodput collapse, queue growth, a
+            # shed burst) rides the dump, so a postmortem needs no live
+            # scrape to see the trajectory.
+            h = _history.get_history().query(since=60.0, step=1.0)
+            if any(s["points"] for s in h.get("series", {}).values()):
+                out.append({"kind": "history", **h})
         except Exception:  # noqa: BLE001
             pass
         rid = trigger.get("request_id")

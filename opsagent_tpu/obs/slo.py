@@ -71,6 +71,38 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+# -- SLO classes -------------------------------------------------------------
+# Every request lands in exactly one class; the label is enum-only (the
+# metrics-conformance cardinality guard rejects anything else). Explicit
+# ``slo_class`` in the request body wins; otherwise the scenario decides
+# (a human is waiting on a diagnosis; an audit sweep is throughput work).
+_SCENARIO_CLASSES = {
+    "diagnose": "interactive",
+    "analyze": "interactive",
+    "execute": "interactive",
+    "audit": "batch",
+}
+
+
+def classify(
+    body: Any = None, scenario: str = "", default: str = "interactive"
+) -> str:
+    """SLO class for one request: ``interactive`` | ``batch`` |
+    ``background``. ``body`` may be the request dict (its ``slo_class``
+    field wins when valid; its ``scenario`` field feeds the fallback)."""
+    from . import SLO_CLASSES
+
+    if isinstance(body, dict):
+        explicit = str(body.get("slo_class") or "").strip().lower()
+        if explicit in SLO_CLASSES:
+            return explicit
+        scenario = scenario or str(body.get("scenario") or "")
+    mapped = _SCENARIO_CLASSES.get(scenario.strip().lower())
+    if mapped:
+        return mapped
+    return default if default in SLO_CLASSES else "interactive"
+
+
 @dataclass(frozen=True)
 class SLO:
     name: str
@@ -177,8 +209,16 @@ class SLOWatchdog:
                 self._snaps.pop(0)
 
     def _decode_rate(self) -> float | None:
-        """tokens/sec over the most recent window, or None before two
-        snapshots >= 1 s apart exist."""
+        """tokens/sec over the most recent window. Rides TelemetryHistory
+        when its sampler has points (servers run it at 1 Hz, so the rate
+        is live ~2 s after boot instead of "UNKNOWN until two ad-hoc
+        snapshots >= 1 s apart"); falls back to the watchdog's own
+        snapshot pair when the sampler is off (bare evaluate() calls)."""
+        from . import history as _history
+
+        r = _history.get_history().rate("decode_tokens", _RATE_WINDOW_S)
+        if r is not None:
+            return r
         with self._lock:
             snaps = list(self._snaps)
         if len(snaps) < 2:
@@ -260,9 +300,82 @@ class SLOWatchdog:
             self._last = out
         return {
             "slos": out,
+            "classes": self.class_report(),
+            "error_budget": _env_float(_ENV_ERR, 0.01),
             "pass": all(v["pass"] is not False for v in out),
             "evaluated_at": time.time(),
         }
+
+    def class_report(self) -> list[dict[str, Any]]:
+        """Per-SLO-class attainment + burn rate, windowed over
+        TelemetryHistory (5 m and 1 h) rather than instantaneous. Only
+        classes that have seen traffic appear; attainment is
+        completed / (completed + bad) where bad covers error, timeout,
+        admission_failed, and shed; burn rate is the SRE convention
+        (1 - attainment) / error_budget, > 1.0 = burning faster than the
+        budget allows."""
+        from . import (
+            CLASS_ITL_SECONDS,
+            CLASS_REQUESTS,
+            CLASS_TTFT_SECONDS,
+            SLO_CLASSES,
+        )
+        from . import history as _history
+
+        budget = _env_float(_ENV_ERR, 0.01)
+        h = _history.get_history()
+        rows: list[dict[str, Any]] = []
+        for cls in SLO_CLASSES:
+            by = {
+                outcome: CLASS_REQUESTS.value(
+                    **{"class": cls, "outcome": outcome}
+                )
+                for outcome in (
+                    "completed", "error", "timeout",
+                    "admission_failed", "shed",
+                )
+            }
+            total = sum(by.values())
+            if total <= 0:
+                continue
+            bad = total - by["completed"]
+            ttft = histogram_quantile(
+                CLASS_TTFT_SECONDS, 0.95, **{"class": cls}
+            )
+            itl = histogram_quantile(
+                CLASS_ITL_SECONDS, 0.95, **{"class": cls}
+            )
+            row: dict[str, Any] = {
+                "class": cls,
+                "requests": int(total),
+                "bad": int(bad),
+                "attainment": round(by["completed"] / total, 6),
+                "ttft_p95_ms": (
+                    None if ttft is None else round(ttft * 1e3, 3)
+                ),
+                "itl_p95_ms": (
+                    None if itl is None else round(itl * 1e3, 3)
+                ),
+                "outcomes": {k: int(v) for k, v in by.items() if v},
+                "windows": {},
+            }
+            for label, win in (("5m", 300.0), ("1h", 3600.0)):
+                done = h.window_sum(f"class.{cls}.completed", win)
+                wbad = h.window_sum(f"class.{cls}.bad", win)
+                wtotal = done + wbad
+                if wtotal <= 0:
+                    continue
+                att = done / wtotal
+                row["windows"][label] = {
+                    "requests": int(wtotal),
+                    "attainment": round(att, 6),
+                    "burn_rate": (
+                        round((1.0 - att) / budget, 4)
+                        if budget > 0 else None
+                    ),
+                }
+            rows.append(row)
+        return rows
 
     def _track_breach(self, v: dict[str, Any]) -> None:
         """Breach bookkeeping: a flight-ring ANOMALY on each pass->fail

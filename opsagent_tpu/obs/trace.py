@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import os
+import random
 import threading
 import time
 import uuid
@@ -38,6 +40,76 @@ from typing import Any, Iterator
 from ..utils.logger import get_logger
 
 log = get_logger("obs.trace")
+
+# -- tail-based retention -----------------------------------------------------
+# At million-session volume a keep-everything ring is useless: the 512
+# slots hold the last few seconds of HEALTHY traffic and the one request
+# you want to investigate is long gone. Tail-based sampling inverts it:
+# the retention decision happens at request FINISH, when we know whether
+# anything went wrong. Anomalous requests (SLO breach, error, failover,
+# engine restart) are always kept; healthy ones survive a probability-p
+# draw (OPSAGENT_TRACE_SAMPLE, default 1.0 = keep all — the single-box
+# dev default). opsagent_trace_retention_total{decision} proves the
+# policy on the scrape.
+_ENV_SAMPLE = "OPSAGENT_TRACE_SAMPLE"
+_sample_p: float | None = None     # None = read the env on first use
+# Request ids marked anomalous from OUTSIDE the trace's own thread (the
+# router's failover path marks the journey id between legs; the resumed
+# leg's fresh Trace under the same id must inherit the flag). Bounded:
+# ids are unbounded, this set must not be.
+_anomalous_ids: "OrderedDict[str, float]" = OrderedDict()
+_anomalous_lock = threading.Lock()
+_ANOMALOUS_CAP = 4096
+
+
+def sample_probability() -> float:
+    global _sample_p
+    if _sample_p is None:
+        try:
+            _sample_p = min(
+                1.0, max(0.0, float(os.environ.get(_ENV_SAMPLE, "1.0")))
+            )
+        except ValueError:
+            _sample_p = 1.0
+    return _sample_p
+
+
+def set_sample_probability(p: float | None) -> None:
+    """Programmatic override (bench/tests); None re-reads the env."""
+    global _sample_p
+    _sample_p = None if p is None else min(1.0, max(0.0, float(p)))
+
+
+def mark_anomalous(request_id: str | None, reason: str = "") -> None:
+    """Flag a request's trace as anomalous so tail-based retention always
+    keeps it. Safe for unknown/absent ids; the flag also outlives the
+    current trace object so a failover's resumed leg (a fresh Trace under
+    the same journey id) inherits it."""
+    if not request_id:
+        return
+    with _anomalous_lock:
+        _anomalous_ids[request_id] = time.time()
+        _anomalous_ids.move_to_end(request_id)
+        while len(_anomalous_ids) > _ANOMALOUS_CAP:
+            _anomalous_ids.popitem(last=False)
+    t = _store.get(request_id)
+    if t is not None:
+        t.anomalous = True
+        if reason:
+            t.anomaly_reason = t.anomaly_reason or reason
+
+
+def _is_marked(request_id: str) -> bool:
+    with _anomalous_lock:
+        return request_id in _anomalous_ids
+
+
+def reset_retention() -> None:
+    """Test-isolation hook: forget marks and the sampling override."""
+    global _sample_p
+    _sample_p = None
+    with _anomalous_lock:
+        _anomalous_ids.clear()
 
 
 class Span:
@@ -118,10 +190,20 @@ class Trace:
         self.started_at = time.time()
         self.root = Span("request", self)
         self.finished = False
+        # Tail-based retention state: anomalous traces are always kept;
+        # slo_class is stamped at ingress and read by the engine/scheduler
+        # observation sites through their span handle (span.trace).
+        self.anomalous = _is_marked(request_id)
+        self.anomaly_reason = ""
+        self.slo_class = ""
 
     def finish(self, **attrs: Any) -> None:
-        """Close the root and emit the structured JSON log event. Safe to
-        call more than once (only the first closes/logs)."""
+        """Close the root, emit the structured JSON log event, and apply
+        the tail-based retention policy (here rather than in
+        ``trace_request`` so directly-managed traces — the OpenAI
+        frontend owns its Trace without the context manager — get the
+        same decision). Safe to call more than once (only the first
+        closes/logs/decides)."""
         with self._lock:
             if self.finished:
                 return
@@ -141,6 +223,7 @@ class Trace:
                 }
             },
         )
+        _store.finalize(self)
 
     def phase_totals_ms(self) -> dict[str, float]:
         """Wall milliseconds per DIRECT child phase of the root, summed by
@@ -157,7 +240,7 @@ class Trace:
         return out
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        d = {
             "request_id": self.request_id,
             "started_at": self.started_at,
             "finished": self.finished,
@@ -165,12 +248,20 @@ class Trace:
             "phases_ms": self.phase_totals_ms(),
             "root": self.root.to_dict(self.root.t0),
         }
+        if self.slo_class:
+            d["slo_class"] = self.slo_class
+        if self.anomalous:
+            d["anomalous"] = True
+            if self.anomaly_reason:
+                d["anomaly_reason"] = self.anomaly_reason
+        return d
 
 
 class TraceStore:
     """Bounded ring of recent traces keyed by request ID. Traces register
-    at START so in-flight requests are inspectable; eviction is strictly
-    insertion-ordered (oldest out)."""
+    at START so in-flight requests are inspectable; eviction prefers the
+    oldest HEALTHY trace so anomalous ones outlive healthy churn (an
+    all-anomalous ring still evicts oldest-first — the bound is hard)."""
 
     def __init__(self, capacity: int = 512):
         self.capacity = capacity
@@ -182,11 +273,46 @@ class TraceStore:
             self._traces[trace.request_id] = trace
             self._traces.move_to_end(trace.request_id)
             while len(self._traces) > self.capacity:
-                self._traces.popitem(last=False)
+                victim = None
+                for rid, t in self._traces.items():
+                    if not t.anomalous:
+                        victim = rid
+                        break
+                if victim is None:
+                    self._traces.popitem(last=False)
+                else:
+                    self._traces.pop(victim, None)
 
     def get(self, request_id: str) -> Trace | None:
         with self._lock:
             return self._traces.get(request_id)
+
+    def discard(self, request_id: str) -> None:
+        with self._lock:
+            self._traces.pop(request_id, None)
+
+    def finalize(self, trace: Trace) -> str:
+        """Apply the tail-based retention policy to a finished trace and
+        return the decision. Anomalous (flagged on the trace or marked by
+        id from another thread, e.g. the router's failover path) is always
+        kept; healthy traces survive a probability-p draw."""
+        if trace.anomalous or _is_marked(trace.request_id):
+            trace.anomalous = True
+            decision = "kept_anomalous"
+        else:
+            p = sample_probability()
+            if p >= 1.0 or random.random() < p:
+                decision = "kept_sampled"
+            else:
+                decision = "dropped"
+                self.discard(trace.request_id)
+        try:
+            from . import TRACE_RETENTION
+
+            TRACE_RETENTION.inc(decision=decision)
+        except Exception:
+            pass
+        return decision
 
     def clear(self) -> None:
         with self._lock:
@@ -214,6 +340,21 @@ def new_request_id(prefix: str = "req") -> str:
 def get_trace(request_id: str) -> dict[str, Any] | None:
     t = _store.get(request_id)
     return None if t is None else t.to_dict()
+
+
+def class_of(handle: Any, default: str = "") -> str:
+    """SLO class of the trace behind a span/trace handle (engine and
+    scheduler sites hold a Span; the ingress stamped slo_class on its
+    Trace). Returns ``default`` for untraced traffic."""
+    if handle is None:
+        return default
+    trace = getattr(handle, "trace", handle)
+    return getattr(trace, "slo_class", "") or default
+
+
+def current_class(default: str = "") -> str:
+    """SLO class of the context's active trace (ReAct-loop side)."""
+    return class_of(_current.get(), default)
 
 
 @contextlib.contextmanager

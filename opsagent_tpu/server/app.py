@@ -35,10 +35,14 @@ _CORS_HEADERS = {
 
 Handler = Callable[[web.Request], Awaitable[web.StreamResponse]]
 
-# /api/slo is public like /metrics: both are read-only health summaries a
-# CI gate / prober hits without credentials. The flight ring and profile
-# capture stay behind the JWT — event attrs can carry request payloads.
-PUBLIC_PATHS = {"/login", "/api/version", "/healthz", "/metrics", "/api/slo"}
+# /api/slo and /api/metrics/history are public like /metrics: read-only
+# health summaries / numeric series a CI gate, prober, or `opsagent top`
+# hits without credentials. The flight ring and profile capture stay
+# behind the JWT — event attrs can carry request payloads.
+PUBLIC_PATHS = {
+    "/login", "/api/version", "/healthz", "/metrics", "/api/slo",
+    "/api/metrics/history",
+}
 
 
 @web.middleware
@@ -142,6 +146,7 @@ def build_app() -> web.Application:
     app.router.add_get("/api/debug/flight", handlers.flight_get)
     app.router.add_get("/api/debug/memory", handlers.memory_profile)
     app.router.add_get("/api/slo", handlers.slo_get)
+    app.router.add_get("/api/metrics/history", handlers.history_get)
     app.router.add_post("/api/debug/profile", handlers.profile_capture)
     return app
 
@@ -151,6 +156,8 @@ def run_server(host: str = "0.0.0.0", port: int = 8080) -> None:
     # Continuous SLO evaluation behind GET /api/slo and the
     # opsagent_slo_* scrape gauges.
     obs.slo.get_watchdog().start()
+    # Telemetry history sampler behind GET /api/metrics/history.
+    obs.history.get_history().start()
 
     async def _announce(_: web.Application) -> None:
         # Logged from on_startup so the line appears only once the socket is

@@ -380,6 +380,17 @@ async def slo_get(request: web.Request) -> web.Response:
     return web.json_response(obs.slo.evaluate())
 
 
+async def history_get(request: web.Request) -> web.Response:
+    """GET /api/metrics/history?series=a,b&since=300&step=10 — recent
+    numeric telemetry from the in-process TelemetryHistory rings (1 s /
+    10 s / 60 s tiers). Public like /metrics: series are numbers only."""
+    try:
+        kwargs = obs.history.parse_query(request.query)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(obs.history.query(**kwargs))
+
+
 async def profile_capture(request: web.Request) -> web.Response:
     """POST /api/debug/profile?seconds=N — capture a jax.profiler device
     trace around live traffic (requires --profile-dir /

@@ -329,28 +329,46 @@ class ServingStack:
         if parent is not None:
             return None, parent, f"chatcmpl-{uuid.uuid4().hex[:24]}"
         rid = str(hop.get("request_id") or "") if hop else ""
+        hop_kind = str(hop.get("hop", "")) if hop else ""
         if rid:
             existing = obs.get_store().get(rid)
             if existing is not None:
+                if hop_kind == "failover":
+                    existing.anomalous = True
                 leg = existing.root.start_child(
                     "fleet_hop",
-                    hop=str(hop.get("hop", "")),
+                    hop=hop_kind,
                     replica=str(hop.get("replica", "")),
                 )
                 return _SpanFinisher(leg), leg, rid
         t = obs.Trace(rid or obs.new_request_id("chatcmpl"))
         if hop:
             t.root.set(
-                hop=str(hop.get("hop", "")),
+                hop=hop_kind,
                 replica=str(hop.get("replica", "")),
             )
+            # A failover leg IS the anomaly: tail-based retention must
+            # keep this journey even on a remote replica that never saw
+            # the router's local mark.
+            if hop_kind == "failover":
+                t.anomalous = True
         obs.get_store().add(t)
         return t, t.root, t.request_id
+
+    @staticmethod
+    def _stamp_class(parent: "obs.Span | None", body: Any) -> None:
+        """Stamp the request's SLO class on its trace. First writer wins:
+        the ReAct loop classifies the OUTER request; nested llm-turn
+        completions inherit rather than reclassify."""
+        t = getattr(parent, "trace", None)
+        if t is not None and not getattr(t, "slo_class", ""):
+            t.slo_class = obs.slo.classify(body)
 
     def chat_completion(self, body: dict[str, Any]) -> dict[str, Any]:
         hop = body.pop("fleet_hop", None) if isinstance(body, dict) \
             else None
         owned, parent, cid = self._request_trace(hop)
+        self._stamp_class(parent, body)
         try:
             return self._chat_completion_traced(body, parent, cid)
         finally:
@@ -494,6 +512,7 @@ class ServingStack:
             raise RequestError("n > 1 is not supported with stream", 400)
         token_q: "queue.Queue[int | None]" = queue.Queue()
         owned, parent, cid = self._request_trace(hop)
+        self._stamp_class(parent, body)
         gen_span = (
             parent.start_child("generate", stream=True)
             if parent is not None else None
@@ -989,6 +1008,20 @@ def build_engine_app(stack: ServingStack, membership=None):
     async def slo_get(request: web.Request) -> web.Response:
         return web.json_response(obs.slo.evaluate())
 
+    async def history_get(request: web.Request) -> web.Response:
+        # GET /api/metrics/history?series=&since=&step= — the telemetry
+        # time machine: tiered-downsample rings for every tracked series
+        # (obs/history.py). The sampler thread is started by
+        # run_engine_server; under a bare test app the endpoint still
+        # answers (empty points) rather than 404ing.
+        try:
+            kwargs = obs.history.parse_query(request.query)
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": f"bad query: {e}"}}, status=400
+            )
+        return web.json_response(obs.history.query(**kwargs))
+
     async def profile_capture(request: web.Request) -> web.Response:
         # POST /api/debug/profile?seconds=N — capture a jax.profiler
         # device trace around LIVE traffic for N seconds (blocking in a
@@ -1189,6 +1222,7 @@ def build_engine_app(stack: ServingStack, membership=None):
     app.router.add_get("/api/debug/flight", flight_get)
     app.router.add_get("/api/debug/memory", memory_profile)
     app.router.add_get("/api/slo", slo_get)
+    app.router.add_get("/api/metrics/history", history_get)
     app.router.add_post("/api/debug/profile", profile_capture)
     app.router.add_post("/v1/profile/start", profile_start)
     app.router.add_post("/v1/profile/stop", profile_stop)
@@ -1298,6 +1332,9 @@ def run_engine_server(
     # keeps the throughput rate window warm and logs breach transitions
     # into the flight ring even when nobody scrapes.
     obs.slo.get_watchdog().start()
+    # Telemetry time machine: 1 Hz sampler behind /api/metrics/history
+    # (tiered downsampling keeps it memory-bounded forever).
+    obs.history.get_history().start()
 
     async def _announce(_) -> None:
         log.info("serving engine listening on %s:%d (model=%s)", host, port, model_name)

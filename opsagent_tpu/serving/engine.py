@@ -2294,7 +2294,10 @@ class Engine:
         anomaly: the ring dump holds the admissions and dispatch
         compositions of the seconds leading up to the slow first token."""
         obs.TTFT_SECONDS.observe(seq.ttft_s)
-        obs.attribution.record_goodput(seq.ttft_s, "prefill")
+        cls = obs.trace.class_of(seq.trace)
+        if cls:
+            obs.CLASS_TTFT_SECONDS.observe(seq.ttft_s, **{"class": cls})
+        obs.attribution.record_goodput(seq.ttft_s, "prefill", slo_class=cls)
         ttft_ms = round(seq.ttft_s * 1e3, 3)
         rid = obs.flight.request_id_of(seq.trace)
         obs.flight.record(
@@ -2320,6 +2323,11 @@ class Engine:
         now = time.perf_counter()
         if seq.last_tok_s:
             obs.ITL_SECONDS.observe(now - seq.last_tok_s)
+            cls = obs.trace.class_of(seq.trace)
+            if cls:
+                obs.CLASS_ITL_SECONDS.observe(
+                    now - seq.last_tok_s, **{"class": cls}
+                )
         seq.last_tok_s = now
         p = seq.params
         if p.presence_penalty or p.frequency_penalty:
@@ -2341,7 +2349,8 @@ class Engine:
             seq.finish_reason = "stop"
         if seq.done and seq.decode_span is not None:
             obs.attribution.record_goodput(
-                seq.decode_span.duration_s(), "decode_active"
+                seq.decode_span.duration_s(), "decode_active",
+                slo_class=obs.trace.class_of(seq.trace),
             )
             seq.decode_span.close(
                 tokens=len(seq.tokens), finish_reason=seq.finish_reason

@@ -173,6 +173,7 @@ class Autoscaler:
         self.interval_s = interval_s
         self._lock = threading.Lock()
         self._shed = 0
+        self._shed_by_class: dict[str, int] = {}
         self._seq = 0
         # -inf, not 0.0: monotonic() counts from boot, so on a freshly
         # booted host 0.0 would put the FIRST launch inside the cooldown
@@ -188,15 +189,20 @@ class Autoscaler:
         self.retired_total = 0
 
     # -- signals -----------------------------------------------------------
-    def note_shed(self) -> None:
-        """Called by FleetRouter._check_overload on every shed 429."""
+    def note_shed(self, slo_class: str = "") -> None:
+        """Called by FleetRouter._check_overload on every shed 429, with
+        the SLO class of the request that was turned away — so the
+        scale-up decision can say WHOSE backlog triggered it."""
         with self._lock:
             self._shed += 1
+            cls = slo_class or "interactive"
+            self._shed_by_class[cls] = self._shed_by_class.get(cls, 0) + 1
 
-    def _take_shed(self) -> int:
+    def _take_shed(self) -> tuple[int, dict[str, int]]:
         with self._lock:
             n, self._shed = self._shed, 0
-            return n
+            by_class, self._shed_by_class = self._shed_by_class, {}
+            return n, by_class
 
     # -- the loop ----------------------------------------------------------
     def tick(self) -> dict[str, Any]:
@@ -228,7 +234,7 @@ class Autoscaler:
             out["promoted"].append(rid)
 
         # 2) Scale up on pressure.
-        shed = self._take_shed()
+        shed, shed_by_class = self._take_shed()
         decode = reg.alive(role="decode")
         depths = [c.queue_depth() for c in decode]
         pressure = shed > 0 or (
@@ -254,13 +260,27 @@ class Autoscaler:
                 self._last_launch = now
                 self.launched_total += 1
                 obs.FLEET_SCALE_EVENTS.inc(direction="up")
+                # Which class's turned-away demand pulled the trigger:
+                # the dominant class in this window's shed tally (ties
+                # break deterministically by name).
+                trigger_class = (
+                    max(
+                        sorted(shed_by_class),
+                        key=lambda c: shed_by_class[c],
+                    )
+                    if shed_by_class else ""
+                )
                 obs.flight.record(
                     "replica_launch", replica=rid, shed_events=shed,
                     min_queue_depth=min(depths) if depths else -1,
+                    trigger_class=trigger_class,
+                    shed_by_class=shed_by_class,
                 )
                 log.info(
-                    "scale-up: launching %s (shed=%d, min queue=%s)",
-                    rid, shed, min(depths) if depths else "n/a",
+                    "scale-up: launching %s (shed=%d, class=%s, "
+                    "min queue=%s)",
+                    rid, shed, trigger_class or "n/a",
+                    min(depths) if depths else "n/a",
                 )
                 out["launched"] = rid
 
@@ -301,11 +321,13 @@ class Autoscaler:
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             shed_pending = self._shed
+            shed_by_class = dict(self._shed_by_class)
         return {
             "max_replicas": self.max_replicas,
             "pending": sorted(self._pending),
             "active": sorted(self._active),
             "shed_pending": shed_pending,
+            "shed_pending_by_class": shed_by_class,
             "launched_total": self.launched_total,
             "promoted_total": self.promoted_total,
             "retired_total": self.retired_total,

@@ -240,6 +240,9 @@ class LocalReplica:
     def slo(self) -> dict[str, Any]:
         return obs.slo.evaluate()
 
+    def history(self, **kwargs: Any) -> dict[str, Any]:
+        return obs.history.query(**kwargs)
+
     def timeline(self, request_id: str) -> dict[str, Any] | None:
         return obs.timeline.assemble(request_id)
 
@@ -405,6 +408,17 @@ class HttpReplica:
     def slo(self) -> dict[str, Any]:
         return self._call("/api/slo", timeout_s=10.0)
 
+    def history(
+        self, series: list[str] | None = None, since: float = 300.0,
+        step: float | None = None,
+    ) -> dict[str, Any]:
+        q = f"?since={since}"
+        if series:
+            q += "&series=" + ",".join(series)
+        if step:
+            q += f"&step={step}"
+        return self._call(f"/api/metrics/history{q}", timeout_s=10.0)
+
     def timeline(self, request_id: str) -> dict[str, Any] | None:
         try:
             return self._call(
@@ -426,6 +440,65 @@ class HttpReplica:
 
 
 # -- routing decisions --------------------------------------------------------
+def _merge_class_reports(
+    reports: list[list[dict[str, Any]]], budget: float
+) -> list[dict[str, Any]]:
+    """Fold per-replica SLO-class reports into one fleet view: requests,
+    bad, and outcome counts sum; attainment is recomputed from the sums;
+    p95 latencies take the worst replica (a fleet p95 cannot be computed
+    from per-replica quantiles — max is the honest bound); history
+    windows merge per label with request-weighted attainment."""
+    by_cls: dict[str, dict[str, Any]] = {}
+    for rows in reports:
+        for c in rows or []:
+            cls = c.get("class")
+            if not cls:
+                continue
+            m = by_cls.setdefault(cls, {
+                "class": cls, "requests": 0, "bad": 0,
+                "ttft_p95_ms": None, "itl_p95_ms": None,
+                "outcomes": {}, "_windows": {},
+            })
+            m["requests"] += int(c.get("requests") or 0)
+            m["bad"] += int(c.get("bad") or 0)
+            for k in ("ttft_p95_ms", "itl_p95_ms"):
+                v = c.get(k)
+                if v is not None:
+                    m[k] = v if m[k] is None else max(m[k], v)
+            for o, n in (c.get("outcomes") or {}).items():
+                m["outcomes"][o] = m["outcomes"].get(o, 0) + int(n)
+            for label, w in (c.get("windows") or {}).items():
+                mw = m["_windows"].setdefault(
+                    label, {"requests": 0, "good": 0.0}
+                )
+                wr = int(w.get("requests") or 0)
+                mw["requests"] += wr
+                mw["good"] += wr * float(w.get("attainment") or 0.0)
+    out: list[dict[str, Any]] = []
+    for cls in obs.SLO_CLASSES:
+        m = by_cls.get(cls)
+        if m is None or m["requests"] <= 0:
+            continue
+        m["attainment"] = round(
+            (m["requests"] - m["bad"]) / m["requests"], 6
+        )
+        windows: dict[str, Any] = {}
+        for label, mw in m.pop("_windows").items():
+            if mw["requests"] <= 0:
+                continue
+            att = mw["good"] / mw["requests"]
+            windows[label] = {
+                "requests": mw["requests"],
+                "attainment": round(att, 6),
+                "burn_rate": (
+                    round((1.0 - att) / budget, 4) if budget > 0 else None
+                ),
+            }
+        m["windows"] = windows
+        out.append(m)
+    return out
+
+
 @dataclass
 class RouteDecision:
     replica: ReplicaInfo
@@ -757,7 +830,7 @@ class FleetRouter:
     # a failover journey).
     _SHAPE_RANK = {"direct": 0, "retried": 1, "hedged": 2, "failover": 3}
 
-    def _new_journey(self) -> str | None:
+    def _new_journey(self, body: Any = None) -> str | None:
         """Mint the journey ID the engine will ADOPT as its completion
         id (same chatcmpl- namespace) and open its participants record.
         None when journeys are off (the obs-overhead kill switch)."""
@@ -767,11 +840,17 @@ class FleetRouter:
         with self._lock:
             self._participants[jid] = {
                 "t0_wall": time.time(), "shape": "direct",
+                "class": obs.slo.classify(body),
                 "replicas": [], "hops": [],
             }
             while len(self._participants) > self._max_map:
                 self._participants.popitem(last=False)
         return jid
+
+    def _journey_class(self, jid: str | None) -> str:
+        with self._lock:
+            rec = self._participants.get(jid) if jid else None
+        return (rec or {}).get("class") or "interactive"
 
     def _stamp_hop(
         self, body: dict[str, Any], jid: str | None, hop: str,
@@ -821,7 +900,8 @@ class FleetRouter:
                 rec["shape"] = shape
 
     def _finish_journey(self, jid: str | None) -> None:
-        """Count the completed journey once, under its final shape."""
+        """Count the completed journey once, under its final shape and
+        SLO class."""
         if not jid:
             return
         with self._lock:
@@ -830,7 +910,8 @@ class FleetRouter:
                 return
             rec["counted"] = True
             shape = rec.get("shape", "direct")
-        obs.FLEET_JOURNEYS.inc(shape=shape)
+            cls = rec.get("class") or "interactive"
+        obs.FLEET_JOURNEYS.inc(**{"shape": shape, "class": cls})
 
     def journey_of(self, request_id: str) -> dict[str, Any] | None:
         """The cross-replica journey of a tracked request (shape +
@@ -961,12 +1042,16 @@ class FleetRouter:
         )
 
     # -- overload shedding ---------------------------------------------------
-    def _check_overload(self, force_replica: str | None) -> None:
+    def _check_overload(
+        self, force_replica: str | None, body: Any = None
+    ) -> None:
         """Router admission control: once EVERY live decode replica's
         queue depth is at or past the watermark, new work is shed with
         429 + Retry-After BEFORE it deepens the queues (backpressure to
         the client instead of melted replicas). Forced routes (operator
-        overrides, drain tooling) bypass the shed."""
+        overrides, drain tooling) bypass the shed. The shed is classed:
+        which class's demand the fleet turned away is the signal the
+        autoscaler's replica_launch decision records as trigger_class."""
         if self.shed_queue_depth is None or force_replica is not None:
             return
         self.registry.refresh_local()
@@ -977,15 +1062,18 @@ class FleetRouter:
         if min(depths) < self.shed_queue_depth:
             return
         retry_after = int(min(30, max(1, min(depths))))
-        obs.FLEET_SHED.inc()
+        cls = obs.slo.classify(body)
+        obs.FLEET_SHED.inc(**{"class": cls})
         if self.autoscaler is not None:
             # Shed = demand the fleet turned away: the strongest scale-up
             # signal there is. Note it before the 429 leaves the building.
-            self.autoscaler.note_shed()
+            self.autoscaler.note_shed(cls)
         obs.FLEET_REQUESTS.inc(outcome="shed")
+        obs.CLASS_REQUESTS.inc(**{"class": cls, "outcome": "shed"})
         obs.flight.record(
             "request_shed", min_queue_depth=min(depths),
             watermark=self.shed_queue_depth, retry_after_s=retry_after,
+            slo_class=cls,
         )
         raise OverloadError(
             "fleet overloaded: every replica queue depth >= "
@@ -1031,7 +1119,7 @@ class FleetRouter:
         (the loser's work is discarded — greedy outputs are identical).
         Each arrival feeds the circuit breaker; the winner's decision is
         what gets recorded/pinned."""
-        obs.FLEET_HEDGES.inc()
+        obs.FLEET_HEDGES.inc(**{"class": self._journey_class(jid)})
         self._note_shape(jid, "hedged")
         obs.flight.record(
             "fleet_hedge", primary=d.replica.replica_id,
@@ -1080,8 +1168,8 @@ class FleetRouter:
         self, body: dict[str, Any], force_replica: str | None = None
     ) -> dict[str, Any]:
         token_ids = self.tokenize(body)
-        self._check_overload(force_replica)
-        jid = self._new_journey()
+        self._check_overload(force_replica, body)
+        jid = self._new_journey(body)
         excluded: set[str] = set()
         attempt = 0
         while True:
@@ -1136,6 +1224,7 @@ class FleetRouter:
                     self._backoff(attempt)
                     continue
                 obs.FLEET_REQUESTS.inc(outcome="error")
+                obs.trace.mark_anomalous(jid, reason="fleet_error")
                 raise
             rid = resp.get("id") if isinstance(resp, dict) else None
             self._record_decision(d, request_id=rid or jid)
@@ -1163,8 +1252,8 @@ class FleetRouter:
         fail over before the first content chunk (a resampled
         continuation would splice two different generations)."""
         token_ids = self.tokenize(body)
-        self._check_overload(force_replica)
-        jid = self._new_journey()
+        self._check_overload(force_replica, body)
+        jid = self._new_journey(body)
         try:
             greedy = float(body.get("temperature") or 0.0) == 0.0
         except (TypeError, ValueError):
@@ -1259,6 +1348,11 @@ class FleetRouter:
                     excluded.add(rid_name)
                     self._note_shape(jid, "failover")
                     obs.FLEET_FAILOVERS.inc()
+                    # Tail-based retention: a failed-over journey is
+                    # always investigation-worthy — pin its trace before
+                    # the resumed leg even starts (the mark outlives the
+                    # first leg's trace object).
+                    obs.trace.mark_anomalous(jid, reason="failover")
                     obs.flight.record(
                         "failover", replica=rid_name,
                         failovers=failovers,
@@ -1269,6 +1363,7 @@ class FleetRouter:
                     self._backoff(failovers)
                     continue
                 obs.FLEET_REQUESTS.inc(outcome="error")
+                obs.trace.mark_anomalous(jid, reason="fleet_error")
                 raise
 
     # -- drain ----------------------------------------------------------------
@@ -1483,6 +1578,14 @@ class FleetRouter:
         one breached replica breaches the fleet."""
         slos: list[dict[str, Any]] = []
         replicas = 0
+        # Per-class accounting starts from THIS process's report (sheds
+        # are classified here; in-process replicas share this registry,
+        # so their completions are already in it) and folds in each
+        # REMOTE replica's classes — remote completions are classified
+        # in the replica process, never here. LocalReplica handles are
+        # skipped in the fold: their slo() reads the same process-wide
+        # registry and would double-count.
+        remote_classes: list[list[dict[str, Any]]] = []
         for info in self.registry.alive(admitting=False):
             if info.handle is None:
                 continue
@@ -1499,7 +1602,64 @@ class FleetRouter:
                 v = dict(v)
                 v["name"] = f"{info.replica_id}:{v.get('name', '?')}"
                 slos.append(v)
-        return {"slos": slos, "fleet": {"replicas": replicas}}
+            if not isinstance(info.handle, LocalReplica):
+                remote_classes.append(verdicts.get("classes") or [])
+        budget = obs.slo._env_float(obs.slo._ENV_ERR, 0.01)
+        classes = _merge_class_reports(
+            [obs.slo.get_watchdog().class_report()] + remote_classes,
+            budget,
+        )
+        return {
+            "slos": slos,
+            "classes": classes,
+            "error_budget": budget,
+            "fleet": {"replicas": replicas},
+        }
+
+    def metrics_history(
+        self, series: list[str] | None = None, since: float = 300.0,
+        step: float | None = None,
+    ) -> dict[str, Any]:
+        """Fleet-wide /api/metrics/history: the router process's own
+        history store (shared by every in-process replica — same
+        registry, same sampler) plus each remote replica's store with
+        series prefixed ``{replica_id}:`` and timestamps skew-corrected
+        into the router's clock via the registry's ClockSync offsets —
+        the same ``wall - offset`` convention as the fleet flight
+        ledger, so stitched timelines and history lines agree."""
+        local = obs.history.query(series=series, since=since, step=step)
+        out_series: dict[str, Any] = dict(local["series"])
+        offsets = self.registry.clock_offsets()
+        replicas: list[str] = []
+        for info in self.registry.alive(admitting=False):
+            replicas.append(info.replica_id)
+            if info.handle is None or isinstance(info.handle, LocalReplica):
+                continue   # local replicas share the router's store
+            try:
+                remote = info.handle.history(
+                    series=series, since=since, step=step
+                )
+            except Exception:  # noqa: BLE001 - degrade to survivors
+                continue
+            off = offsets.get(info.replica_id, 0.0)
+            for name, s in remote.get("series", {}).items():
+                out_series[f"{info.replica_id}:{name}"] = {
+                    "kind": s.get("kind", "gauge"),
+                    "points": [
+                        [p[0] - off, p[1]]
+                        for p in s.get("points", [])
+                        if isinstance(p, (list, tuple)) and len(p) == 2
+                    ],
+                }
+        return {
+            "now": local["now"],
+            "since": since,
+            "step": step,
+            "tiers": local["tiers"],
+            "replicas": replicas,
+            "clock_offset_s": offsets,
+            "series": out_series,
+        }
 
     def fleet_snapshot(self) -> dict[str, Any]:
         """GET /api/fleet: the registry view plus a per-replica SLO
@@ -1689,6 +1849,20 @@ def build_router_app(router: FleetRouter):
     async def slo_get(request: web.Request) -> web.Response:
         return web.json_response(await _exec(router.slo_aggregate))
 
+    async def history_get(request: web.Request) -> web.Response:
+        # GET /api/metrics/history — fleet-aggregated telemetry history:
+        # router-process series plus replica-prefixed remote series,
+        # timestamps skew-corrected via the registry's clock offsets.
+        try:
+            kwargs = obs.history.parse_query(request.query)
+        except ValueError as e:
+            return web.json_response(
+                {"error": {"message": f"bad query: {e}"}}, status=400
+            )
+        return web.json_response(await _exec(
+            lambda: router.metrics_history(**kwargs)
+        ))
+
     async def timeline_get(request: web.Request) -> web.Response:
         tl = await _exec(
             router.timeline, request.match_info["request_id"]
@@ -1864,6 +2038,7 @@ def build_router_app(router: FleetRouter):
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/api/slo", slo_get)
+    app.router.add_get("/api/metrics/history", history_get)
     app.router.add_get("/api/fleet", fleet_get)
     app.router.add_get("/api/fleet/bench", fleet_bench)
     app.router.add_get("/api/fleet/directory", directory_get)
@@ -1936,6 +2111,10 @@ def run_router_server(
         router.autoscaler = scaler
         scaler.start()
     app = build_router_app(router)
+    # Telemetry time machine: the router samples its own process series
+    # (fleet shed/hedge/failover rates ride here) at 1 Hz behind
+    # /api/metrics/history.
+    obs.history.get_history().start()
 
     async def _announce(_) -> None:
         log.info("fleet router listening on %s:%d", host, port)

@@ -252,6 +252,10 @@ class Scheduler:
             if now - req.enqueued_s > self.admission_timeout_s:
                 req.error = "admission timed out (engine saturated)"
                 obs.ENGINE_REQUESTS.inc(outcome="timeout")
+                obs.CLASS_REQUESTS.inc(**{
+                    "class": obs.trace.class_of(req.trace, "interactive"),
+                    "outcome": "timeout",
+                })
                 obs.flight.anomaly(
                     "request_error", error=req.error,
                     request_id=obs.flight.request_id_of(req.trace),
@@ -312,7 +316,10 @@ class Scheduler:
                 "scheduler.queue_wait", wait_s * 1e3, "ms"
             )
             obs.QUEUE_WAIT_SECONDS.observe(wait_s)
-            obs.attribution.record_goodput(wait_s, "queued")
+            obs.attribution.record_goodput(
+                wait_s, "queued",
+                slo_class=obs.trace.class_of(req.trace),
+            )
             if req.trace is not None:
                 req.trace.child("queue_wait", req.enqueued_s, now)
         self._waiting = still
@@ -664,6 +671,10 @@ class Scheduler:
         if isinstance(e, (InvalidRequest, PromptTooLong)):
             req.error_status = 400
         obs.ENGINE_REQUESTS.inc(outcome="admission_failed")
+        obs.CLASS_REQUESTS.inc(**{
+            "class": obs.trace.class_of(req.trace, "interactive"),
+            "outcome": "admission_failed",
+        })
         obs.flight.anomaly(
             "request_error", seq_id=sid, error=str(e),
             request_id=obs.flight.request_id_of(req.trace),
@@ -689,6 +700,10 @@ class Scheduler:
             obs.ENGINE_REQUESTS.inc(
                 outcome="error" if req.error else "completed"
             )
+            obs.CLASS_REQUESTS.inc(**{
+                "class": obs.trace.class_of(req.trace, "interactive"),
+                "outcome": "error" if req.error else "completed",
+            })
             if req.error:
                 obs.flight.anomaly(
                     "request_error", seq_id=sid, error=req.error,

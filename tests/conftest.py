@@ -109,6 +109,8 @@ def _reset_obs():
     obs.flight.get_recorder().reset()
     obs.flight.reset_compile_watchdog()
     obs.slo.get_watchdog().reset()
+    obs.history.reset()
+    obs.trace.reset_retention()
     # Fault injection is process-global: clear hit counters and unpin any
     # spec a test configured so chaos never leaks across tests.
     from opsagent_tpu.serving import faults as _faults

@@ -225,7 +225,10 @@ class TestElasticScaleOut:
             router.shed_queue_depth = 0
             with pytest.raises(OverloadError):
                 router.complete(dict(CHAT))
-            assert obs.FLEET_SHED.value() == 1
+            assert sum(
+                obs.FLEET_SHED.value(**{"class": c})
+                for c in obs.SLO_CLASSES
+            ) == 1
 
             out = scaler.tick()
             assert out["launched"] == "scale-1"

@@ -353,7 +353,10 @@ class TestRouterFailover:
         try:
             resp = router.complete(dict(self.BODY))
             assert resp["choices"][0]["message"]["content"]
-            assert obs.FLEET_HEDGES.value() >= 1
+            assert sum(
+                obs.FLEET_HEDGES.value(**{"class": c})
+                for c in obs.SLO_CLASSES
+            ) >= 1
             hedges = _flight("fleet_hedge")
             assert hedges and {
                 hedges[-1]["primary"], hedges[-1]["backup"]
@@ -405,7 +408,10 @@ class TestOverload:
                 })
             assert ei.value.status == 429
             assert ei.value.retry_after_s >= 1
-            assert obs.FLEET_SHED.value() == 1
+            assert sum(
+                obs.FLEET_SHED.value(**{"class": c})
+                for c in obs.SLO_CLASSES
+            ) == 1
             assert obs.FLEET_REQUESTS.value(outcome="shed") == 1
             assert _flight("request_shed")
         finally:

@@ -160,12 +160,13 @@ class TestParticipantsMap:
 
     def test_finish_counts_shape_exactly_once(self):
         router = FleetRouter()
-        before = obs.FLEET_JOURNEYS.value(shape="hedged")
+        key = {"shape": "hedged", "class": "interactive"}
+        before = obs.FLEET_JOURNEYS.value(**key)
         jid = router._new_journey()
         router._note_shape(jid, "hedged")
         router._finish_journey(jid)
         router._finish_journey(jid)
-        assert obs.FLEET_JOURNEYS.value(shape="hedged") == before + 1
+        assert obs.FLEET_JOURNEYS.value(**key) == before + 1
 
 
 # -- stitcher unit behavior ---------------------------------------------------
@@ -490,7 +491,10 @@ def test_failover_plus_fault_in_yields_one_stitched_timeline():
         for prev, cur in zip(tl["segments"], tl["segments"][1:]):
             assert cur["start_ms"] >= prev["end_ms"] - 1e-6, (prev, cur)
         # The journey counted once under its most eventful shape.
-        assert obs.FLEET_JOURNEYS.value(shape="failover") >= 1
+        assert sum(
+            obs.FLEET_JOURNEYS.value(**{"shape": "failover", "class": c})
+            for c in obs.SLO_CLASSES
+        ) >= 1
         # Renderable as a multi-lane gantt with both replica lanes.
         art = obs_timeline.render_fleet_gantt(tl)
         assert "lane r0:" in art and "lane r1:" in art

@@ -201,8 +201,8 @@ def test_fleet_journey_families_on_the_scrape():
     gauge — present and typed once traffic touches them."""
     obs.FLEET_HOP_SECONDS.observe(0.012, hop="route")
     obs.FLEET_HOP_SECONDS.observe(0.034, hop="failover")
-    obs.FLEET_JOURNEYS.inc(shape="direct")
-    obs.FLEET_JOURNEYS.inc(shape="failover")
+    obs.FLEET_JOURNEYS.inc(**{"shape": "direct", "class": "interactive"})
+    obs.FLEET_JOURNEYS.inc(**{"shape": "failover", "class": "batch"})
     obs.FLEET_CLOCK_SKEW.set(0.004, replica="r1")
     text = obs.metrics_text()
     for family, kind in (
@@ -212,8 +212,82 @@ def test_fleet_journey_families_on_the_scrape():
     ):
         assert f"# TYPE {family} {kind}" in text, family
     assert 'opsagent_fleet_hop_seconds_count{hop="route"}' in text
-    assert 'opsagent_fleet_journeys_total{shape="failover"}' in text
+    assert ('opsagent_fleet_journeys_total{shape="failover",'
+            'class="batch"}') in text
     assert 'opsagent_fleet_clock_skew_seconds{replica="r1"}' in text
+
+
+def test_class_and_history_families_on_the_scrape():
+    """The ISSUE 18 families (SLO classes, tail-based trace retention,
+    telemetry history) are present and typed once traffic touches them —
+    a rename is a visible contract break."""
+    obs.CLASS_REQUESTS.inc(**{"class": "interactive", "outcome": "completed"})
+    obs.CLASS_REQUESTS.inc(**{"class": "batch", "outcome": "shed"})
+    obs.CLASS_TTFT_SECONDS.observe(0.05, **{"class": "interactive"})
+    obs.CLASS_ITL_SECONDS.observe(0.004, **{"class": "interactive"})
+    obs.CLASS_GOODPUT_SECONDS.inc(
+        0.2, **{"class": "interactive", "phase": "decode_active"}
+    )
+    obs.TRACE_RETENTION.inc(decision="kept_anomalous")
+    obs.TRACE_RETENTION.inc(decision="dropped")
+    obs.HISTORY_SAMPLES.inc()
+    obs.HISTORY_POINTS.set(12, tier="1s")
+    obs.HISTORY_BYTES.set(1440)
+    text = obs.metrics_text()
+    for family, kind in (
+        ("opsagent_class_requests_total", "counter"),
+        ("opsagent_class_ttft_seconds", "histogram"),
+        ("opsagent_class_itl_seconds", "histogram"),
+        ("opsagent_class_goodput_seconds_total", "counter"),
+        ("opsagent_trace_retention_total", "counter"),
+        ("opsagent_history_samples_total", "counter"),
+        ("opsagent_history_points", "gauge"),
+        ("opsagent_history_bytes", "gauge"),
+    ):
+        assert f"# TYPE {family} {kind}" in text, family
+    assert ('opsagent_class_requests_total{class="interactive",'
+            'outcome="completed"}') in text
+    assert 'opsagent_trace_retention_total{decision="dropped"}' in text
+    assert 'opsagent_history_points{tier="1s"}' in text
+
+
+def test_class_labels_are_enum_only():
+    """Cardinality guard for the new ``class`` label: every class-labeled
+    sample on the scrape must carry one of the three declared SLO
+    classes — a scenario name, model name, or request id leaking into
+    the class label would be unbounded cardinality."""
+    _generate_traffic()
+    obs.CLASS_REQUESTS.inc(**{"class": "interactive", "outcome": "completed"})
+    obs.FLEET_SHED.inc(**{"class": "batch"})
+    obs.FLEET_HEDGES.inc(**{"class": "background"})
+    obs.FLEET_JOURNEYS.inc(**{"shape": "direct", "class": "interactive"})
+    text = obs.metrics_text()
+    cls_re = re.compile(r'class="([^"]*)"')
+    found = 0
+    for ln in text.splitlines():
+        if ln.startswith("#"):
+            continue
+        for m in cls_re.finditer(ln):
+            found += 1
+            assert m.group(1) in obs.SLO_CLASSES, (
+                f"non-enum class label on the scrape: {ln!r}"
+            )
+    assert found > 0, "no class-labeled samples rendered"
+
+
+def test_classify_rejects_unknown_values_to_default():
+    """obs.slo.classify is the only writer of the class label: bogus
+    explicit values and unknown scenarios must clamp to the enum (the
+    upstream half of the cardinality guard above)."""
+    from opsagent_tpu.obs import slo as obs_slo
+
+    assert obs_slo.classify({"slo_class": "batch"}) == "batch"
+    assert obs_slo.classify({"slo_class": "vip-customer-42"}) \
+        == "interactive"
+    assert obs_slo.classify(scenario="audit") == "batch"
+    assert obs_slo.classify(scenario="diagnose") == "interactive"
+    assert obs_slo.classify(scenario="no-such-scenario",
+                            default="background") == "background"
 
 
 def test_no_metric_family_is_keyed_by_raw_request_id():
@@ -223,7 +297,7 @@ def test_no_metric_family_is_keyed_by_raw_request_id():
     scrape. Journey traffic runs first so a regression would be ON the
     exposition when we scan it."""
     obs.FLEET_HOP_SECONDS.observe(0.01, hop="route")
-    obs.FLEET_JOURNEYS.inc(shape="direct")
+    obs.FLEET_JOURNEYS.inc(**{"shape": "direct", "class": "interactive"})
     _generate_traffic()
     text = obs.metrics_text()
     id_like = re.compile(
