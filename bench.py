@@ -897,7 +897,7 @@ def run_single() -> None:
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "sessions-ffwd", "fleet-affinity",
                 "fleet-chaos", "fleet-global-kv", "fleet-journey",
-                "obs-history", "cold-start"):
+                "audit-fanout", "obs-history", "cold-start"):
         # Full-stack modes measure concurrency/TTFT; keep speculation out
         # of them (their warmup level does not compile the spec program).
         spec_k = 0
@@ -973,7 +973,7 @@ def run_single() -> None:
         async_depth=async_depth,
         offload=(mode in ("sessions-offload", "fleet-affinity",
                           "fleet-chaos", "fleet-global-kv",
-                          "fleet-journey")),
+                          "fleet-journey", "audit-fanout")),
     )
     # Fail fast on undersized sweep points: OutOfPages mid-window would
     # force-finish sequences ('length') and quietly deflate the metric.
@@ -1014,7 +1014,7 @@ def run_single() -> None:
     if mode in ("sessions", "agent", "sessions-mixed", "sessions-offload",
                 "sessions-async", "sessions-ffwd", "fleet-affinity",
                 "fleet-chaos", "fleet-global-kv", "fleet-journey",
-                "obs-history"):
+                "audit-fanout", "obs-history"):
         level = "sessions"
     elif spec_k > 0:
         level = "bench-spec"
@@ -1059,6 +1059,10 @@ def run_single() -> None:
     if mode == "fleet-journey":
         run_fleet_journey(eng, cfg, model, batch, steps, prompt_len,
                           platform, n_chips, quantize, init_s, warmup_s)
+        return
+    if mode == "audit-fanout":
+        run_audit_fanout(eng, cfg, model, batch, steps, prompt_len,
+                         platform, n_chips, quantize, init_s, warmup_s)
         return
     if mode == "obs-history":
         run_obs_history(eng, model, batch, steps, prompt_len, platform,
@@ -2359,6 +2363,159 @@ def run_fleet_global_kv(eng, cfg, model, batch, steps, prompt_len,
             "slo": slo_verdicts(),
         },
     }), flush=True)
+    log_perf_table()
+    for s in stacks:
+        s.close()
+    exit_if_slo_breach(slo_verdicts())
+
+
+def run_audit_fanout(eng, cfg, model, batch, steps, prompt_len, platform,
+                     n_chips, quantize, init_s, warmup_s) -> None:
+    """The audit-fanout stage (agent/fanout): one cluster-scale audit as
+    a fan-out/reduce workload over OPSAGENT_BENCH_REPLICAS (default 2)
+    in-process replicas behind the fleet router. The seeded synthetic
+    cluster gives ground truth, so the stage scores RECALL (must be 1.0)
+    alongside the serving numbers: end-to-end audit latency (the
+    headline, lower-better), per-fan-out shared-prefix hit rate
+    (higher-better, its own result row), goodput (children/s), and the
+    fraction of children whose prefill was served from the primed shared
+    prefix. The audit runs TWICE — pass 1 warms the fan-out shape and
+    pins the canonical report bytes, pass 2 is measured (post-warmup
+    compiles over it must be zero) with a concurrent INTERACTIVE probe
+    streaming against the same fleet: batch-class children must not
+    starve interactive TTFT (reported as p50_ttft_ms so the perf gate
+    ratchets it)."""
+    import threading
+    from dataclasses import replace as dc_replace
+
+    from opsagent_tpu import obs as obs_mod
+    from opsagent_tpu.agent.fanout import (
+        FanoutConfig, SynthCluster, run_audit,
+    )
+    from opsagent_tpu.serving.api import ServingStack
+    from opsagent_tpu.serving.engine import Engine
+    from opsagent_tpu.serving.fleet.router import FleetRouter
+
+    n_replicas = int(os.environ.get("OPSAGENT_BENCH_REPLICAS", "2"))
+    resources = int(os.environ.get(
+        "OPSAGENT_BENCH_FANOUT_RESOURCES", str(max(8, batch * 4))
+    ))
+    gen_tokens = max(8, steps // 8)
+    engines = [eng]
+    for _ in range(1, n_replicas):
+        e = Engine(dc_replace(cfg, seed=cfg.seed))
+        e.warmup("sessions")
+        engines.append(e)
+    stacks = [ServingStack(e) for e in engines]
+    router = FleetRouter(sticky=False)
+    for i, s in enumerate(stacks):
+        router.add_local(s, f"bench-r{i}")
+    cluster = SynthCluster(resources=resources, seed=0)
+    fcfg = FanoutConfig(
+        max_inflight=max(2, batch), max_tokens=gen_tokens,
+    )
+
+    rep1 = run_audit(router, cluster, fcfg)
+    compiles0 = obs_mod.POST_WARMUP_COMPILES.value()
+    ttft_ms: list[float] = []
+    probe_errors: list[str] = []
+    stop = threading.Event()
+
+    def interactive_probe() -> None:
+        n = 0
+        while not stop.is_set():
+            n += 1
+            t0 = time.perf_counter()
+            try:
+                gen = router.complete_stream({
+                    "messages": [
+                        {"role": "user", "content": f"fleet status {n}"},
+                    ],
+                    "max_tokens": 4, "temperature": 0.0, "stream": True,
+                    "slo_class": "interactive",
+                })
+                first = next(gen)
+                if "error" in first:
+                    raise RuntimeError(first["error"]["message"])
+                ttft_ms.append((time.perf_counter() - t0) * 1e3)
+                for ch in gen:
+                    if "error" in ch:
+                        raise RuntimeError(ch["error"]["message"])
+            except Exception as e:  # noqa: BLE001 - probe outcome IS data
+                probe_errors.append(f"{type(e).__name__}: {e}")
+            stop.wait(0.05)
+
+    probe = threading.Thread(target=interactive_probe, daemon=True)
+    probe.start()
+    rep2 = run_audit(router, cluster, fcfg)
+    stop.set()
+    probe.join(timeout=30.0)
+    post_compiles = obs_mod.POST_WARMUP_COMPILES.value() - compiles0
+
+    s1, s2 = rep1.stats, rep2.stats
+    byte_identical = rep1.canonical == rep2.canonical
+    recall = rep2.recall(cluster)
+    audit_s = float(s2["audit_s"])
+    goodput = resources / max(1e-9, audit_s)
+    failed = resources - int(s2["outcomes"].get("ok", 0))
+    p50_ttft = float(np.median(ttft_ms)) if ttft_ms else 0.0
+    snap = metrics_snapshot()
+    qtag = f",{quantize}" if quantize else ""
+    tag = f"{model}{qtag},N={resources},R={n_replicas},{platform}"
+    extra = {
+        "replicas": n_replicas,
+        "resources": resources,
+        "children_ok": int(s2["outcomes"].get("ok", 0)),
+        "failed_children": failed,
+        "outcomes": s2["outcomes"],
+        "recall": recall,
+        "byte_identical": byte_identical,
+        "goodput_children_s": round(goodput, 2),
+        "prefix_hit_rate": s2["prefix_hit_rate"],
+        "avoided_children": s2["avoided_children"],
+        "shared_prefix_tokens": s2["shared_prefix_tokens"],
+        "prefix_hit_tokens": s2["prefix_hit_tokens"],
+        "scatter_s": round(float(s2["scatter_s"]), 3),
+        "reduce_s": round(float(s2["reduce_s"]), 4),
+        "warm_audit_ratio": round(
+            audit_s / max(1e-9, float(s1["audit_s"])), 3
+        ),
+        "post_compiles": post_compiles,
+        "p50_ttft_ms": round(p50_ttft, 1),
+        "interactive_probes": len(ttft_ms),
+        "probe_errors": len(probe_errors),
+        "probe_error_detail": probe_errors[:4],
+        "init_s": round(init_s, 1),
+        "warmup_s": round(warmup_s, 1),
+        "chips": n_chips,
+        "platform": platform,
+        "metrics": snap,
+        "attribution": attribution_snapshot(),
+        "slo": slo_verdicts(),
+    }
+    print(json.dumps({
+        "metric": f"audit_fanout[{tag}]",
+        "value": round(audit_s, 3),
+        "unit": "audit_latency_s",
+        "extra": extra,
+    }), flush=True)
+    # The hit rate gets its own row so the perf gate ratchets BOTH
+    # directions: latency cannot creep up, the shared-prefix path cannot
+    # silently degrade into per-child re-prefill.
+    print(json.dumps({
+        "metric": f"audit_fanout_prefix_hit[{tag}]",
+        "value": round(float(s2["prefix_hit_rate"]), 4),
+        "unit": "prefix_hit_rate",
+        "extra": {"avoided_children": s2["avoided_children"],
+                  "resources": resources},
+    }), flush=True)
+    log(f"bench[audit-fanout]: {resources} resources over {n_replicas} "
+        f"replicas in {audit_s:.2f}s (goodput {goodput:.1f} children/s); "
+        f"recall={recall:.2f} prefix_hit={s2['prefix_hit_rate']:.2f} "
+        f"avoided={s2['avoided_children']}/{resources} "
+        f"byte_identical={byte_identical} failed={failed} "
+        f"post-warmup compiles {post_compiles:.0f}; interactive p50 TTFT "
+        f"{p50_ttft:.0f} ms over {len(ttft_ms)} probes")
     log_perf_table()
     for s in stacks:
         s.close()

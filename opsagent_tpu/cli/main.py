@@ -68,6 +68,45 @@ def build_parser() -> argparse.ArgumentParser:
     au.add_argument("--namespace", default="default")
     _add_common(au)
 
+    af = sub.add_parser(
+        "audit-fanout",
+        help="fan one audit out over a synthetic cluster: N batch-class "
+             "child sessions sharing one prefix chain through an "
+             "in-process fleet, reduced to one deterministic report "
+             "(exit 0 all children ok, 1 any finding_unavailable)",
+    )
+    af.add_argument("--model", default="tiny-test")
+    af.add_argument(
+        "--resources", type=int, default=64,
+        help="synthetic cluster size (= fan-out children)",
+    )
+    af.add_argument("--seed", type=int, default=0)
+    af.add_argument(
+        "--issue-fraction", type=float, default=0.25,
+        help="fraction of resources given an injected issue",
+    )
+    af.add_argument(
+        "--replicas", type=int, default=2,
+        help="in-process decode replicas behind the router",
+    )
+    af.add_argument(
+        "--max-inflight", type=int, default=8,
+        help="bounded scatter concurrency (the fan-out admission gate)",
+    )
+    af.add_argument("--max-tokens", type=int, default=16)
+    af.add_argument(
+        "--flight-sample", type=int, default=0,
+        help=">1: sample admission/dispatch flight kinds 1-in-N during "
+             "the wave (flood control)",
+    )
+    af.add_argument(
+        "--json", action="store_true",
+        help="print the canonical byte-stable report form",
+    )
+    af.add_argument(
+        "--out", default="", help="also write the canonical report here",
+    )
+
     di = sub.add_parser("diagnose", help="diagnose problems for a pod")
     di.add_argument("--name", required=True)
     di.add_argument("--namespace", default="default")
@@ -873,6 +912,22 @@ def main(argv: list[str] | None = None) -> int:
         result = audit_flow(args.model, args.name, args.namespace)
         print(render_markdown(result))
         return 0
+
+    if args.command == "audit-fanout":
+        from .fanout import run_audit_fanout
+
+        return run_audit_fanout(
+            model=args.model,
+            resources=args.resources,
+            seed=args.seed,
+            issue_fraction=args.issue_fraction,
+            replicas=args.replicas,
+            max_inflight=args.max_inflight,
+            max_tokens=args.max_tokens,
+            flight_sample=args.flight_sample,
+            as_json=args.json,
+            out=args.out,
+        )
 
     if args.command == "diagnose":
         from ..agent.prompts import DIAGNOSE_SYSTEM_PROMPT

@@ -99,10 +99,12 @@ def _higher_better(unit: str) -> bool:
     u = (unit or "").lower()
     if u in (
         "ms", "s", "seconds", "failed_requests", "errors",
-        "request_ready_s", "ms/turn", "overhead_pct",
+        "request_ready_s", "ms/turn", "overhead_pct", "audit_latency_s",
     ):
         return False
-    return True  # tok/s/chip and friends
+    # tok/s/chip and friends — including prefix_hit_rate (a fan-out
+    # whose children start re-prefilling the shared prefix regresses).
+    return True
 
 
 def _series(rows: list[dict[str, Any]]) -> dict[str, dict[str, Any]]:

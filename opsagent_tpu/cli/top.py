@@ -167,6 +167,45 @@ def _series_points(
     return [[ts, merged[ts]] for ts in sorted(merged)]
 
 
+def _series_last(
+    series: dict[str, Any], name: str, agg: str = "sum"
+) -> float | None:
+    """Latest value of ``name`` across the local and ``{rid}:``-prefixed
+    replica series (sum for counts, max for rates/ratios); None when no
+    series carries a point."""
+    vals: list[float] = []
+    for key, body in series.items():
+        if key != name and not key.endswith(f":{name}"):
+            continue
+        points = body.get("points", [])
+        if points:
+            vals.append(float(points[-1][1]))
+    if not vals:
+        return None
+    return max(vals) if agg == "max" else sum(vals)
+
+
+def _fanout_row(hist: dict[str, Any] | None, p: _Palette) -> list[str]:
+    """One cockpit row for audit fan-outs (agent/fanout): active count,
+    children done/planned of the newest fan-out, shared-prefix hit rate,
+    and the child completion trend — all via the history sampler."""
+    series = (hist or {}).get("series", {})
+    active = _series_last(series, "fanout.active")
+    done = _series_last(series, "fanout.children_done")
+    planned = _series_last(series, "fanout.children_planned")
+    hit = _series_last(series, "fanout.prefix_hit_rate", agg="max")
+    if not any(v for v in (active, done, planned)):
+        return [f"{p.dim}(no fan-outs observed){p.reset}"]
+    spark = sparkline(_series_points(series, "fanout.children"))
+    return [
+        f"{'active':<8} {'children':>12} {'prefix hit':>11}  trend",
+        f"{int(active or 0):<8} "
+        f"{f'{int(done or 0)}/{int(planned or 0)}':>12} "
+        f"{(f'{hit * 100:9.1f}%' if hit is not None else '         -'):>11}"
+        f"  {spark}",
+    ]
+
+
 def _anomaly_rows(
     flight: dict[str, Any] | None, p: _Palette, n: int = 5
 ) -> list[str]:
@@ -214,6 +253,9 @@ def render_frame(
     lines.append(f"{p.bold}slo classes{p.reset}")
     lines.extend(_class_rows(slo or {}, hist, p))
     lines.append("")
+    lines.append(f"{p.bold}audit fan-out{p.reset}")
+    lines.extend(_fanout_row(hist, p))
+    lines.append("")
     lines.append(f"{p.bold}anomaly tail{p.reset}")
     lines.extend(_anomaly_rows(flight, p))
     return "\n".join(lines) + "\n"
@@ -239,6 +281,10 @@ def run_top(
         ["decode_tokens"]
         + [f"class.{c}.completed"
            for c in ("interactive", "batch", "background")]
+        + [f"fanout.{s}" for s in (
+            "active", "children_planned", "children_done",
+            "prefix_hit_rate", "children",
+        )]
     )
     hist_q = (
         f"?since={_SPARK_WINDOW_S}&step={_SPARK_STEP_S}"

@@ -525,6 +525,50 @@ CLASS_GOODPUT_SECONDS = _reg.counter(
     "split of opsagent_goodput_seconds_total)",
     labelnames=("class", "phase"),
 )
+# -- audit fan-out: plan/scatter/reduce over the fleet (agent/fanout) ---------
+FANOUT_CHILDREN = _reg.counter(
+    "opsagent_fanout_children_total",
+    "Fan-out child sessions by outcome (ok / shed / failed; shed and "
+    "failed children become finding_unavailable rows, never lost audits)",
+    labelnames=("outcome",),
+)
+FANOUT_FINDINGS = _reg.counter(
+    "opsagent_fanout_findings_total",
+    "Findings merged by the fan-out reduce phase, by severity "
+    "(closed enum: critical/high/medium/low/none/unavailable)",
+    labelnames=("severity",),
+)
+FANOUT_REPREFILL_AVOIDED = _reg.counter(
+    "opsagent_fanout_reprefill_avoided_tokens_total",
+    "Shared-prefix prompt tokens fan-out children served from cache "
+    "instead of re-prefilling (the fleet-global-KV win the fan-out "
+    "exists to harvest)",
+)
+FANOUT_REDUCE_SECONDS = _reg.histogram(
+    "opsagent_fanout_reduce_seconds",
+    "Wall time of one fan-out reduce phase (merge + stable sort + "
+    "canonical report)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5),
+)
+FANOUT_ACTIVE = _reg.gauge(
+    "opsagent_fanout_active",
+    "Fan-out audits currently in flight in this process",
+)
+FANOUT_CHILDREN_TOTAL = _reg.gauge(
+    "opsagent_fanout_children_planned",
+    "Children planned by the most recent fan-out (top's done/total row)",
+)
+FANOUT_CHILDREN_DONE = _reg.gauge(
+    "opsagent_fanout_children_done",
+    "Children finished (any outcome) of the most recent fan-out",
+)
+FANOUT_PREFIX_HIT_RATE = _reg.gauge(
+    "opsagent_fanout_prefix_hit_rate",
+    "Shared-prefix hit rate of the most recent fan-out (prefix-cache "
+    "tokens hit over children x shared-prefix tokens, 0..1)",
+)
+
 TRACE_RETENTION = _reg.counter(
     "opsagent_trace_retention_total",
     "Tail-based trace retention decisions at request finish "

@@ -61,9 +61,30 @@ _ENV_DIR = "OPSAGENT_FLIGHT_DIR"
 _ENV_CAPACITY = "OPSAGENT_FLIGHT_CAPACITY"
 _ENV_DUMP_INTERVAL = "OPSAGENT_FLIGHT_DUMP_INTERVAL_S"
 _ENV_TTFT_MS = "OPSAGENT_SLO_TTFT_MS"
+_ENV_SAMPLE = "OPSAGENT_FLIGHT_SAMPLE"
+_ENV_ANOMALY_HOLD = "OPSAGENT_FLIGHT_ANOMALY_HOLD_S"
 
 DEFAULT_CAPACITY = 2048
 DEFAULT_DUMP_INTERVAL_S = 5.0
+DEFAULT_ANOMALY_HOLD_S = 2.0
+
+
+def _parse_sample_spec(spec: str) -> dict[str, int]:
+    """``"admission=8,dispatch=16"`` -> per-kind keep-1-in-N rates.
+    Rates <= 1 (and junk) are dropped: 1-in-1 is just "record"."""
+    rates: dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        kind, _, val = part.partition("=")
+        try:
+            rate = int(val)
+        except ValueError:
+            continue
+        if kind.strip() and rate > 1:
+            rates[kind.strip()] = rate
+    return rates
 
 
 def flight_dir() -> str:
@@ -106,12 +127,44 @@ class FlightRecorder:
         self._anomalies = 0
         self._last_dump_s = 0.0    # perf_counter of the last JSONL dump
         self.last_dump_path: str | None = None
+        # Flood control: a fan-out admission wave emits thousands of
+        # admission/dispatch events in seconds — enough to wrap the ring
+        # and evict the anomaly context it exists to keep. Per-kind
+        # keep-1-in-N sampling throttles the high-volume kinds; for
+        # ``anomaly_hold_s`` after any anomaly the sampling is suspended
+        # so anomaly-adjacent events are always retained verbatim.
+        self._sample_rates = _parse_sample_spec(
+            os.environ.get(_ENV_SAMPLE, "")
+        )
+        self._kind_seen: dict[str, int] = {}
+        self._sampled_out: dict[str, int] = {}
+        self._retain_until = 0.0   # perf_counter deadline of the hold-off
+        try:
+            self.anomaly_hold_s = float(
+                os.environ.get(_ENV_ANOMALY_HOLD, "")
+            )
+        except ValueError:
+            self.anomaly_hold_s = DEFAULT_ANOMALY_HOLD_S
 
     # -- recording ---------------------------------------------------------
+    def set_sample_rate(self, kind: str, rate: int) -> None:
+        """Keep 1 in ``rate`` events of ``kind`` (rate <= 1 restores
+        full recording). The fan-out orchestrator raises rates on the
+        high-volume kinds for the duration of its admission wave."""
+        with self._lock:
+            if rate > 1:
+                self._sample_rates[kind] = int(rate)
+            else:
+                self._sample_rates.pop(kind, None)
+                self._kind_seen.pop(kind, None)
+
     def record(self, kind: str, **fields: Any) -> dict[str, Any]:
         """Append one event. ``fields`` must be JSON-serializable (the
         dump path str()s anything that is not, rather than losing the
-        ring to one exotic attr)."""
+        ring to one exotic attr). Kinds under a sample rate are recorded
+        1-in-N (suppressed events are counted in stats, not ringed),
+        except inside the post-anomaly hold-off window, where everything
+        is retained."""
         ev = {
             "ts": time.perf_counter(),
             "wall": time.time(),
@@ -119,6 +172,14 @@ class FlightRecorder:
         }
         ev.update(fields)
         with self._lock:
+            rate = self._sample_rates.get(kind)
+            if rate and ev["ts"] >= self._retain_until:
+                seen = self._kind_seen.get(kind, 0)
+                self._kind_seen[kind] = seen + 1
+                if seen % rate != 0:
+                    self._sampled_out[kind] = \
+                        self._sampled_out.get(kind, 0) + 1
+                    return ev
             self._seq += 1
             ev["id"] = self._seq
             if len(self._ring) == self.capacity:
@@ -137,6 +198,14 @@ class FlightRecorder:
         SCRAPE time (the SLO collector), where mutating a scrape-visible
         counter would make consecutive renders of an idle registry
         disagree."""
+        # Anomaly-adjacent events must survive flood control: suspend
+        # per-kind sampling for the hold-off window so the events that
+        # explain (and follow) the anomaly land in the ring verbatim.
+        with self._lock:
+            self._retain_until = max(
+                self._retain_until,
+                time.perf_counter() + self.anomaly_hold_s,
+            )
         ev = self.record("anomaly", reason=reason, **fields)
         if count:
             try:
@@ -273,17 +342,26 @@ class FlightRecorder:
                 "capacity": self.capacity,
                 "total_recorded": self._seq,
                 "dropped": self._dropped,
+                "sampled_out": dict(self._sampled_out),
+                "sample_rates": dict(self._sample_rates),
                 "last_dump_path": self.last_dump_path,
             }
 
     def reset(self) -> None:
-        """Test-isolation hook: clear the ring and the dump rate limit."""
+        """Test-isolation hook: clear the ring, the dump rate limit, and
+        the flood-control state (rates re-read from the environment)."""
         with self._lock:
             self._ring.clear()
             self._seq = 0
             self._dropped = 0
             self._last_dump_s = 0.0
             self.last_dump_path = None
+            self._sample_rates = _parse_sample_spec(
+                os.environ.get(_ENV_SAMPLE, "")
+            )
+            self._kind_seen.clear()
+            self._sampled_out.clear()
+            self._retain_until = 0.0
 
 
 _recorder: FlightRecorder | None = None

@@ -441,6 +441,11 @@ def install_default_series(h: TelemetryHistory) -> None:
         CLASS_TTFT_SECONDS,
         DECODE_TOKENS,
         ENGINE_REQUESTS,
+        FANOUT_ACTIVE,
+        FANOUT_CHILDREN,
+        FANOUT_CHILDREN_DONE,
+        FANOUT_CHILDREN_TOTAL,
+        FANOUT_PREFIX_HIT_RATE,
         FLEET_FAILOVERS,
         FLEET_HEDGES,
         FLEET_RETRIES,
@@ -540,6 +545,22 @@ def install_default_series(h: TelemetryHistory) -> None:
                 **{"class": cls},
             ),
         )
+
+    # Audit fan-out cockpit row (opsagent top): active fan-outs, children
+    # done/planned of the newest one, its shared-prefix hit rate, and the
+    # all-outcome child completion rate.
+    h.register("fanout.active", "gauge", FANOUT_ACTIVE.value)
+    h.register(
+        "fanout.children_planned", "gauge", FANOUT_CHILDREN_TOTAL.value
+    )
+    h.register("fanout.children_done", "gauge", FANOUT_CHILDREN_DONE.value)
+    h.register(
+        "fanout.prefix_hit_rate", "gauge", FANOUT_PREFIX_HIT_RATE.value
+    )
+    h.register(
+        "fanout.children", "counter",
+        functools.partial(_counter_total, FANOUT_CHILDREN),
+    )
 
 
 def _class_bad(counter: Any, cls: str) -> float:

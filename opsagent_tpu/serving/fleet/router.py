@@ -101,6 +101,17 @@ def _retryable(e: BaseException) -> bool:
     )
 
 
+def _fanout_of(body: Any) -> str | None:
+    """The fan-out ID a request body carries, if any (agent/fanout
+    orchestrator children) — threaded through journeys and flight events
+    so one fan-out is traceable end to end across the fleet."""
+    try:
+        fid = body.get("fanout_id")
+    except AttributeError:
+        return None
+    return str(fid) if fid else None
+
+
 def _chunk_content(chunk: Any) -> str:
     """Content delta carried by an SSE chunk dict ('' for head/finish)."""
     if not isinstance(chunk, dict):
@@ -527,6 +538,7 @@ class FleetRouter:
         max_failovers: int = DEFAULT_MAX_FAILOVERS,
         hedge_queue_depth: int | None = None,
         shed_queue_depth: int | None = None,
+        batch_shed_queue_depth: int | None = None,
         pagestore: bool = True,
         journeys: bool | None = None,
     ):
@@ -545,7 +557,11 @@ class FleetRouter:
         duplicate of a queued cold non-streaming admission on a second
         replica, ``shed_queue_depth`` (None = off) sheds new admissions
         with 429 + Retry-After once EVERY live decode replica's queue
-        is at or past the watermark.
+        is at or past the watermark. ``batch_shed_queue_depth`` is the
+        earlier watermark for batch/background requests (default: half
+        of ``shed_queue_depth``, floor 1) — fan-out children soak
+        LEFTOVER capacity, so batch work backs off before it can be
+        what pushes interactive traffic to its own shed line.
 
         ``pagestore`` wires every ``add_local`` replica's engine with a
         fleet-global KV fault-in client against this router's directory
@@ -574,6 +590,7 @@ class FleetRouter:
         self.max_failovers = max_failovers
         self.hedge_queue_depth = hedge_queue_depth
         self.shed_queue_depth = shed_queue_depth
+        self.batch_shed_queue_depth = batch_shed_queue_depth
         self._tokenizer = tokenizer
         self._model_family = model_family
         self.pagestore = pagestore
@@ -812,7 +829,8 @@ class FleetRouter:
         )
 
     def _record_decision(
-        self, d: RouteDecision, request_id: str | None = None
+        self, d: RouteDecision, request_id: str | None = None,
+        fanout_id: str | None = None,
     ) -> None:
         obs.FLEET_ROUTE_DECISIONS.inc(policy=d.policy)
         obs.FLEET_AFFINITY_PAGES.observe(float(d.affinity_pages))
@@ -822,6 +840,7 @@ class FleetRouter:
             affinity_tokens=d.affinity_pages * d.replica.page_size,
             queue_depth=d.queue_depth, session=d.session,
             **({"request_id": request_id} if request_id else {}),
+            **({"fanout_id": fanout_id} if fanout_id else {}),
         )
 
     # -- journey bookkeeping -------------------------------------------------
@@ -837,11 +856,13 @@ class FleetRouter:
         if not self.journeys:
             return None
         jid = obs.new_request_id("chatcmpl")
+        fanout_id = _fanout_of(body)
         with self._lock:
             self._participants[jid] = {
                 "t0_wall": time.time(), "shape": "direct",
                 "class": obs.slo.classify(body),
                 "replicas": [], "hops": [],
+                **({"fanout_id": fanout_id} if fanout_id else {}),
             }
             while len(self._participants) > self._max_map:
                 self._participants.popitem(last=False)
@@ -1051,18 +1072,31 @@ class FleetRouter:
         the client instead of melted replicas). Forced routes (operator
         overrides, drain tooling) bypass the shed. The shed is classed:
         which class's demand the fleet turned away is the signal the
-        autoscaler's replica_launch decision records as trigger_class."""
+        autoscaler's replica_launch decision records as trigger_class.
+
+        The watermark is per class: batch/background admissions shed at
+        ``batch_shed_queue_depth`` (default half the interactive
+        watermark), so a fan-out wave only soaks capacity interactive
+        traffic is not using — batch demand backs off while interactive
+        requests still admit freely."""
         if self.shed_queue_depth is None or force_replica is not None:
             return
+        cls = obs.slo.classify(body)
+        watermark = self.shed_queue_depth
+        if cls != "interactive":
+            watermark = (
+                self.batch_shed_queue_depth
+                if self.batch_shed_queue_depth is not None
+                else max(1, self.shed_queue_depth // 2)
+            )
         self.registry.refresh_local()
         cands = self.registry.alive(role="decode")
         if not cands:
             return  # route() raises its own 503
         depths = [c.queue_depth() for c in cands]
-        if min(depths) < self.shed_queue_depth:
+        if min(depths) < watermark:
             return
         retry_after = int(min(30, max(1, min(depths))))
-        cls = obs.slo.classify(body)
         obs.FLEET_SHED.inc(**{"class": cls})
         if self.autoscaler is not None:
             # Shed = demand the fleet turned away: the strongest scale-up
@@ -1070,14 +1104,16 @@ class FleetRouter:
             self.autoscaler.note_shed(cls)
         obs.FLEET_REQUESTS.inc(outcome="shed")
         obs.CLASS_REQUESTS.inc(**{"class": cls, "outcome": "shed"})
+        fanout_id = _fanout_of(body)
         obs.flight.record(
             "request_shed", min_queue_depth=min(depths),
-            watermark=self.shed_queue_depth, retry_after_s=retry_after,
+            watermark=watermark, retry_after_s=retry_after,
             slo_class=cls,
+            **({"fanout_id": fanout_id} if fanout_id else {}),
         )
         raise OverloadError(
-            "fleet overloaded: every replica queue depth >= "
-            f"{self.shed_queue_depth}; retry later", retry_after,
+            f"fleet overloaded: every replica queue depth >= {watermark} "
+            f"(class {cls}); retry later", retry_after,
         )
 
     @staticmethod
@@ -1227,7 +1263,9 @@ class FleetRouter:
                 obs.trace.mark_anomalous(jid, reason="fleet_error")
                 raise
             rid = resp.get("id") if isinstance(resp, dict) else None
-            self._record_decision(d, request_id=rid or jid)
+            self._record_decision(
+                d, request_id=rid or jid, fanout_id=_fanout_of(body),
+            )
             self._note_ownership(d, rid, jid)
             self._finish_journey(jid)
             obs.FLEET_REQUESTS.inc(outcome="completed")
@@ -1305,7 +1343,10 @@ class FleetRouter:
                     if first:
                         req_id = chunk.get("id") \
                             if isinstance(chunk, dict) else None
-                        self._record_decision(d, request_id=req_id or jid)
+                        self._record_decision(
+                            d, request_id=req_id or jid,
+                            fanout_id=_fanout_of(body),
+                        )
                         self._note_ownership(d, req_id, jid)
                         first = False
                     content = _chunk_content(chunk)

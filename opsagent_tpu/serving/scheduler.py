@@ -83,6 +83,17 @@ class Request:
         self.done = threading.Event()
 
 
+# Admission precedence by SLO class: lower admits first. Unclassed
+# requests rank as interactive (the default class everywhere else).
+_CLASS_ADMIT_RANK = {"interactive": 0, "batch": 1, "background": 2}
+
+
+def _admit_rank(req: Request) -> int:
+    return _CLASS_ADMIT_RANK.get(
+        obs.trace.class_of(req.trace, "interactive"), 0
+    )
+
+
 class Scheduler:
     def __init__(
         self,
@@ -241,7 +252,16 @@ class Scheduler:
         budget and batch slots allow. Only the cheap page allocation
         happens here (engine.begin_request); the device work is advanced
         one chunk per loop tick by ``_advance_prefill`` so long prompts
-        cannot stall running decodes."""
+        cannot stall running decodes.
+
+        Admission is class-fair, not FIFO: interactive requests admit
+        ahead of batch (and batch ahead of background) within the same
+        tick, so a fan-out's thousand batch children queued an instant
+        before an interactive request cannot inflate its TTFT by the
+        whole wave. The sort is stable — arrival order is preserved
+        within a class."""
+        if len(self._waiting) > 1:
+            self._waiting.sort(key=_admit_rank)
         still: list[Request] = []
         now = time.perf_counter()
         for req in self._waiting:

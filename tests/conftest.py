@@ -151,6 +151,7 @@ SLOW_TESTS = (
     "test_pipeline.py::test_pp_remat_matches",
     "test_real_checkpoint.py::test_agent_loop_from_saved_checkpoint",
     "test_train_checkpoint.py::test_save_restore_roundtrip",
+    "test_fanout.py::test_cluster_audit_acceptance_200",
     "test_engine.py::test_long_generation_crosses_pages",
     "test_engine.py::test_generate_matches_oracle",
     "test_engine.py::test_warmup_compiles_without_disturbing_state",

@@ -484,3 +484,42 @@ def test_ragged_sweep_mode_emits_per_backend_identical_rows():
     assert summary["extra"]["cells"] == 6
     assert summary["value"] == max(r["value"] for r in cells)
     assert len(summary["extra"]["cell_tok_s_chip"]) == 6
+
+
+def test_audit_fanout_mode_reports_numbers():
+    """OPSAGENT_BENCH_MODE=audit-fanout must exit 0 and report the
+    fan-out's decision numbers: recall 1.0 against the injected ground
+    truth, a prefix-hit rate, and a byte-identical reduce across its two
+    audit passes — plus the hit rate as its own higher-better row."""
+    out = _run_bench({
+        "JAX_PLATFORMS": "cpu",
+        "OPSAGENT_BENCH_MODE": "audit-fanout",
+        "OPSAGENT_BENCH_MODEL": "tiny-test",
+        "OPSAGENT_BENCH_BATCH": "3",
+        "OPSAGENT_BENCH_STEPS": "16",
+    })
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    rows = []
+    for ln in out.stdout.splitlines():
+        try:
+            d = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and "metric" in d:
+            rows.append(d)
+    main = [r for r in rows if r["metric"].startswith("audit_fanout[")]
+    hit = [
+        r for r in rows
+        if r["metric"].startswith("audit_fanout_prefix_hit[")
+    ]
+    assert len(main) == 1 and len(hit) == 1
+    r = main[0]
+    assert r["unit"] == "audit_latency_s" and r["value"] > 0
+    e = r["extra"]
+    assert e["recall"] == 1.0
+    assert e["byte_identical"] is True
+    assert e["failed_children"] == 0
+    assert 0.0 <= e["prefix_hit_rate"] <= 1.0
+    assert e["avoided_children"] >= 0.9 * e["resources"]
+    assert e["interactive_probes"] >= 1 and e["probe_errors"] == 0
+    assert hit[0]["unit"] == "prefix_hit_rate"

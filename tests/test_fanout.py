@@ -1,0 +1,489 @@
+"""Cluster-scale audit fan-out (ISSUE 19): plan/scatter/reduce over the
+fleet router.
+
+Covers the tentpole's guarantees on the tiny CPU fleet:
+
+- the synthetic cluster is a pure function of (resources, seed,
+  issue_fraction) and the deterministic detector recovers every injected
+  issue from its probe evidence (recall ground truth is trustworthy);
+- the reduce is byte-identical across runs and contains per-child
+  failures as ``finding_unavailable`` rows instead of dropping
+  resources;
+- N concurrent children sharing one system+context prefix re-prefill it
+  at most once per replica (priming + prefix trie), on a single replica
+  AND on a 2-replica fleet;
+- the router's admission gate sheds batch-class work at a LOWER
+  watermark than interactive, and the scheduler admits interactive
+  ahead of queued batch children within one tick;
+- the acceptance run: a >= 200-resource cluster over a 2-replica
+  in-process fleet with zero failed children, recall 1.0, >= 90% of
+  children avoiding re-prefill, a byte-identical reduce, zero
+  post-warmup compiles over the measured audit, and concurrent
+  interactive traffic still admitting and completing (slow lane; the
+  tier-1 twin runs the same gates at 24 resources).
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import pytest
+
+from opsagent_tpu import obs
+from opsagent_tpu.agent.fanout import (
+    FanoutConfig,
+    SynthCluster,
+    detect_findings,
+    run_audit,
+)
+from opsagent_tpu.agent.fanout.synthcluster import (
+    ISSUE_SEVERITY,
+    severity_rank,
+)
+from opsagent_tpu.serving.api import ServingStack
+from opsagent_tpu.serving.engine import Engine, EngineConfig
+from opsagent_tpu.serving.fleet.router import FleetRouter, OverloadError
+from opsagent_tpu.serving.scheduler import _admit_rank
+
+# Fan-out child prompts carry the shared system+inventory prefix
+# (~280 byte-tokens under tiny-test); the usual 4x64 test geometry tops
+# out at 256 tokens/seq, so the fan-out fleet gets 8x64 = 512.
+BASE = dict(
+    model="tiny-test", dtype=jnp.float32, tp=1, page_size=8,
+    num_pages=512, max_pages_per_seq=64, max_batch_size=4,
+    prefill_buckets=(32, 64, 128), decode_block=4, seed=0,
+    offload=True,
+)
+
+
+def _fleet(n=2, **router_kw):
+    router = FleetRouter(sticky=False, **router_kw)
+    stacks = []
+    for i in range(n):
+        stack = ServingStack(Engine(EngineConfig(**BASE)))
+        stacks.append(stack)
+        router.add_local(stack, f"r{i}")
+    return router, stacks
+
+
+def _close(stacks):
+    for s in stacks:
+        s.close()
+
+
+# -- synthetic cluster + ground truth ----------------------------------------
+class TestSynthCluster:
+    def test_pure_function_of_inputs(self):
+        a = SynthCluster(resources=32, seed=7)
+        b = SynthCluster(resources=32, seed=7)
+        assert a.work_items() == b.work_items()
+        assert a.ground_truth() == b.ground_truth()
+        assert all(
+            a.describe(r) == b.describe(r) for r in a.work_items()
+        )
+        c = SynthCluster(resources=32, seed=8)
+        assert c.work_items() != a.work_items()
+
+    def test_issue_fraction_and_archetype_mix(self):
+        c = SynthCluster(resources=40, seed=1, issue_fraction=0.25)
+        truth = c.ground_truth()
+        assert len(truth) == 10
+        # Round-robin assignment: every archetype is represented.
+        assert {f["issue"] for f in truth} == set(ISSUE_SEVERITY)
+        # Reduce-sorted: severities in rank order.
+        ranks = [severity_rank(f["severity"]) for f in truth]
+        assert ranks == sorted(ranks)
+
+    def test_detector_recovers_every_injected_issue(self):
+        c = SynthCluster(resources=48, seed=3)
+        for p in c.pods:
+            found = detect_findings(c.describe(p.resource), p.resource)
+            issues = {f["issue"] for f in found}
+            if p.issue is None:
+                assert not found
+            else:
+                assert p.issue in issues
+                for f in found:
+                    assert f["resource"] == p.resource
+                    assert f["severity"] == ISSUE_SEVERITY[f["issue"]]
+
+    def test_unknown_resource_probe_is_not_found(self):
+        c = SynthCluster(resources=4, seed=0)
+        assert "NotFound" in c.describe("nowhere/ghost")
+
+
+# -- reduce semantics on a fake router (no engine) ----------------------------
+class _FakeInfo:
+    page_size = 4
+
+    def __init__(self, rid):
+        self.replica_id = rid
+
+
+class _FakeRegistry:
+    def __init__(self, n):
+        self._infos = [_FakeInfo(f"r{i}") for i in range(n)]
+
+    def alive(self, role=None):
+        return list(self._infos)
+
+
+class _FakeRouter:
+    """Tokenize = one token per char; complete succeeds unless the
+    resource matches ``fail`` (always) or ``shed_once`` (first call)."""
+
+    def __init__(self, n=1, fail=(), shed_once=()):
+        self.registry = _FakeRegistry(n)
+        self.fail = set(fail)
+        self.shed = set(shed_once)
+        self.forced = []
+
+    def tokenize(self, body):
+        return [
+            ord(ch) for m in body["messages"] for ch in m["content"]
+        ]
+
+    def complete(self, body, force_replica=None):
+        if force_replica is not None:
+            self.forced.append(force_replica)
+            return {"choices": [{"message": {"content": "{}"}}]}
+        user = body["messages"][1]["content"]
+        for r in self.fail:
+            if r in user:
+                raise RuntimeError("child exploded")
+        for r in tuple(self.shed):
+            if r in user:
+                self.shed.discard(r)
+                raise OverloadError("fleet overloaded", 1)
+        return {"choices": [{"message": {"content": "{}"}}]}
+
+
+class TestReduce:
+    def test_byte_identical_and_full_recall(self):
+        cluster = SynthCluster(resources=24, seed=5)
+        cfg = FanoutConfig(max_inflight=4, retry_backoff_s=0.0)
+        r1 = run_audit(_FakeRouter(n=2), cluster, cfg)
+        r2 = run_audit(_FakeRouter(n=2), cluster, cfg)
+        assert r1.canonical == r2.canonical
+        assert r1.recall(cluster) == 1.0
+        assert r1.stats["outcomes"] == {"ok": 24, "shed": 0, "failed": 0}
+        assert r1.stats["primes"] == 2
+        # Findings arrive reduce-sorted.
+        ranks = [severity_rank(f["severity"]) for f in r1.findings]
+        assert ranks == sorted(ranks)
+        assert r1.report["summary"]["audited"] == 24
+
+    def test_failed_child_contained_as_unavailable_row(self):
+        cluster = SynthCluster(resources=12, seed=2)
+        victim = cluster.work_items()[3]
+        cfg = FanoutConfig(retries=1, retry_backoff_s=0.0)
+        rep = run_audit(_FakeRouter(fail=(victim,)), cluster, cfg)
+        assert rep.stats["outcomes"]["failed"] == 1
+        rows = [
+            f for f in rep.findings if f["issue"] == "finding_unavailable"
+        ]
+        assert len(rows) == 1 and rows[0]["resource"] == victim
+        assert rows[0]["severity"] == "unavailable"
+        # Every resource is represented: audited + unavailable = planned.
+        assert rep.report["summary"]["audited"] == 11
+        assert rep.report["summary"]["unavailable"] == 1
+        # Same failures -> same bytes (containment is deterministic too).
+        rep2 = run_audit(_FakeRouter(fail=(victim,)), cluster, cfg)
+        assert rep2.canonical == rep.canonical
+
+    def test_shed_child_retries_and_recovers(self):
+        cluster = SynthCluster(resources=8, seed=4)
+        victim = cluster.work_items()[0]
+        router = _FakeRouter(shed_once=(victim,))
+        rep = run_audit(
+            router, cluster,
+            FanoutConfig(retries=2, retry_backoff_s=0.0),
+        )
+        assert rep.stats["outcomes"] == {"ok": 8, "shed": 0, "failed": 0}
+        assert rep.recall(cluster) == 1.0
+
+    def test_plan_and_reduce_land_in_flight_ledger(self):
+        cluster = SynthCluster(resources=6, seed=9)
+        rep = run_audit(_FakeRouter(), cluster, FanoutConfig())
+        rec = obs.flight.get_recorder()
+        plans = [
+            e for e in rec.snapshot(kind="fanout_plan")
+            if e["fanout_id"] == rep.fanout_id
+        ]
+        reduces = [
+            e for e in rec.snapshot(kind="fanout_reduce")
+            if e["fanout_id"] == rep.fanout_id
+        ]
+        assert len(plans) == 1 and plans[0]["children"] == 6
+        assert len(reduces) == 1
+        assert reduces[0]["outcomes"]["ok"] == 6
+
+    def test_fanout_metrics_and_history_series(self):
+        cluster = SynthCluster(resources=5, seed=6)
+        ok0 = obs.FANOUT_CHILDREN.value(outcome="ok")
+        run_audit(_FakeRouter(), cluster, FanoutConfig())
+        assert obs.FANOUT_CHILDREN.value(outcome="ok") - ok0 == 5
+        assert obs.FANOUT_CHILDREN_TOTAL.value() == 5.0
+        assert obs.FANOUT_CHILDREN_DONE.value() == 5.0
+        assert obs.FANOUT_ACTIVE.value() == 0.0
+        h = obs.history.get_history()
+        h.sample()
+        series = h.query(since=60.0, step=1.0)["series"]
+        for name in (
+            "fanout.active", "fanout.children_planned",
+            "fanout.children_done", "fanout.prefix_hit_rate",
+            "fanout.children",
+        ):
+            assert name in series, name
+        assert series["fanout.children_done"]["points"][-1][1] == 5.0
+
+
+# -- router admission gate: per-class shed watermark --------------------------
+class _DepthInfo:
+    def __init__(self, depth):
+        self._depth = depth
+
+    def queue_depth(self):
+        return self._depth
+
+
+class _DepthRegistry:
+    def __init__(self, depths):
+        self._infos = [_DepthInfo(d) for d in depths]
+
+    def refresh_local(self):
+        pass
+
+    def alive(self, role=None):
+        return list(self._infos)
+
+
+class TestBatchShedWatermark:
+    def _router(self, depths, **kw):
+        router = FleetRouter(sticky=False, shed_queue_depth=8, **kw)
+        router.registry = _DepthRegistry(depths)
+        return router
+
+    def test_batch_sheds_at_half_interactive_watermark(self):
+        router = self._router([5, 6])
+        # Interactive admits: 5 < 8.
+        router._check_overload(None, {"slo_class": "interactive"})
+        # Batch sheds: 5 >= 8 // 2.
+        with pytest.raises(OverloadError) as ei:
+            router._check_overload(
+                None, {"slo_class": "batch", "fanout_id": "fo-1"},
+            )
+        assert ei.value.retry_after_s >= 1
+        ev = obs.flight.get_recorder().snapshot(kind="request_shed")[-1]
+        assert ev["watermark"] == 4
+        assert ev["slo_class"] == "batch"
+        assert ev["fanout_id"] == "fo-1"
+
+    def test_explicit_batch_watermark_wins(self):
+        router = self._router([5, 6], batch_shed_queue_depth=6)
+        router._check_overload(None, {"slo_class": "batch"})
+        router2 = self._router([6, 7], batch_shed_queue_depth=6)
+        with pytest.raises(OverloadError):
+            router2._check_overload(None, {"slo_class": "batch"})
+
+    def test_interactive_watermark_unchanged(self):
+        router = self._router([8, 9])
+        with pytest.raises(OverloadError):
+            router._check_overload(None, {"slo_class": "interactive"})
+
+
+# -- scheduler class fairness -------------------------------------------------
+class TestSchedulerFairness:
+    def test_admit_rank_orders_classes_stably(self):
+        def req(cls, tag):
+            r = SimpleNamespace(
+                trace=SimpleNamespace(slo_class=cls), tag=tag
+            )
+            return r
+
+        waiting = [
+            req("batch", "b0"), req("background", "g0"), req("batch", "b1"),
+            req("interactive", "i0"), req("", "u0"), req("batch", "b2"),
+            req("interactive", "i1"),
+        ]
+        waiting.sort(key=_admit_rank)
+        # Interactive (and unclassed-as-interactive) first, background
+        # last, arrival order preserved within each class.
+        assert [r.tag for r in waiting] == [
+            "i0", "u0", "i1", "b0", "b1", "b2", "g0",
+        ]
+
+    def test_interactive_admits_before_queued_batch(self):
+        """One busy single-slot engine; two batch children queued BEFORE
+        an interactive request must not delay it: on slot release the
+        class-fair sort admits interactive first."""
+        cfg = dict(BASE, max_batch_size=1)
+        stack = ServingStack(Engine(EngineConfig(**cfg)))
+        router = FleetRouter(sticky=False)
+        router.add_local(stack, "r0")
+        finished: dict[str, float] = {}
+        lock = threading.Lock()
+
+        def submit(name, cls, max_tokens):
+            def run():
+                router.complete({
+                    "messages": [
+                        {"role": "user", "content": f"work {name}"},
+                    ],
+                    "max_tokens": max_tokens, "temperature": 0.0,
+                    "slo_class": cls,
+                })
+                with lock:
+                    finished[name] = time.perf_counter()
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            return t
+
+        try:
+            threads = [submit("hog", "interactive", 48)]
+            time.sleep(0.3)  # the hog occupies the only slot
+            threads += [
+                submit("batch-0", "batch", 8),
+                submit("batch-1", "batch", 8),
+            ]
+            time.sleep(0.3)  # batch children are queued behind the hog
+            threads += [submit("inter", "interactive", 8)]
+            for t in threads:
+                t.join(timeout=180)
+            assert set(finished) == {"hog", "batch-0", "batch-1", "inter"}
+            assert finished["inter"] < finished["batch-0"]
+            assert finished["inter"] < finished["batch-1"]
+        finally:
+            _close([stack])
+
+
+# -- shared-prefix admission over real fleets ---------------------------------
+class TestSharedPrefixFanout:
+    def test_single_replica_children_share_one_prefill(self):
+        router, stacks = _fleet(n=1)
+        try:
+            cluster = SynthCluster(resources=6, seed=0)
+            rep = run_audit(
+                router, cluster,
+                FanoutConfig(max_inflight=4, max_tokens=8),
+            )
+            n = cluster.resources
+            assert rep.stats["outcomes"]["ok"] == n
+            assert rep.stats["primes"] == 1
+            assert rep.stats["shared_prefix_tokens"] > 0
+            # Priming paid the one allowed prefill; all N children hit.
+            assert rep.stats["avoided_children"] >= n - 1
+            assert rep.stats["prefix_hit_rate"] >= (n - 1) / n
+            assert rep.recall(cluster) == 1.0
+        finally:
+            _close(stacks)
+
+    def test_two_replica_fleet_children_hit_everywhere(self):
+        router, stacks = _fleet(n=2)
+        try:
+            cluster = SynthCluster(resources=8, seed=1)
+            rep = run_audit(
+                router, cluster,
+                FanoutConfig(max_inflight=4, max_tokens=8),
+            )
+            n = cluster.resources
+            assert rep.stats["outcomes"]["ok"] == n
+            assert rep.stats["primes"] == 2
+            # One prime per replica: whichever replica a child lands on,
+            # its shared prefix is already trie-resident.
+            assert rep.stats["avoided_children"] >= n - 1
+            assert rep.recall(cluster) == 1.0
+            assert rep.canonical  # non-empty deterministic bytes
+            # fanout_id threads into the router's route decisions.
+            decisions = [
+                e for e in obs.flight.get_recorder().snapshot(
+                    kind="route_decision"
+                )
+                if e.get("fanout_id") == rep.fanout_id
+            ]
+            assert len(decisions) >= n
+        finally:
+            _close(stacks)
+
+
+# -- acceptance ---------------------------------------------------------------
+def _acceptance(resources: int):
+    """The ISSUE-19 acceptance scenario at a configurable cluster size."""
+    router, stacks = _fleet(n=2)
+    try:
+        for s in stacks:
+            s.engine.warmup("sessions")
+        cluster = SynthCluster(resources=resources, seed=0)
+        cfg = FanoutConfig(max_inflight=8, max_tokens=8)
+        # Pass 1 pins the canonical bytes and absorbs any residual
+        # first-shape compiles; one interactive probe warms the
+        # streaming path for the same reason.
+        rep1 = run_audit(router, cluster, cfg)
+        list(router.complete_stream({
+            "messages": [{"role": "user", "content": "warm probe"}],
+            "max_tokens": 4, "temperature": 0.0, "stream": True,
+            "slo_class": "interactive",
+        }))
+        compiles0 = obs.POST_WARMUP_COMPILES.value()
+
+        ttft_ms: list[float] = []
+        shed: list[str] = []
+        stop = threading.Event()
+
+        def probe():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                t0 = time.perf_counter()
+                try:
+                    gen = router.complete_stream({
+                        "messages": [
+                            {"role": "user", "content": f"status {i}"},
+                        ],
+                        "max_tokens": 4, "temperature": 0.0,
+                        "stream": True, "slo_class": "interactive",
+                    })
+                    next(gen)
+                    ttft_ms.append((time.perf_counter() - t0) * 1e3)
+                    for _ in gen:
+                        pass
+                except Exception as e:  # noqa: BLE001
+                    shed.append(f"{type(e).__name__}: {e}")
+                stop.wait(0.05)
+
+        th = threading.Thread(target=probe, daemon=True)
+        th.start()
+        rep2 = run_audit(router, cluster, cfg)
+        stop.set()
+        th.join(timeout=60)
+
+        n = cluster.resources
+        # Zero failed children; every resource audited.
+        assert rep2.stats["outcomes"] == {"ok": n, "shed": 0, "failed": 0}
+        # Recall 1.0 against the injected ground truth.
+        assert rep2.recall(cluster) == 1.0
+        # >= 90% of children avoided re-prefilling the shared prefix.
+        assert rep2.stats["avoided_children"] >= 0.9 * n
+        # Byte-identical reduce across the two runs.
+        assert rep2.canonical == rep1.canonical
+        # Zero post-warmup compiles over the measured audit.
+        assert obs.POST_WARMUP_COMPILES.value() - compiles0 == 0
+        # Concurrent interactive traffic kept flowing: probes completed,
+        # none were shed or errored, and their TTFT stayed sane.
+        assert ttft_ms and not shed
+        ttft_ms.sort()
+        assert ttft_ms[len(ttft_ms) // 2] < 2000.0
+    finally:
+        _close(stacks)
+
+
+def test_cluster_audit_acceptance_tier1():
+    """Tier-1 twin of the acceptance run (same gates, 24 resources)."""
+    _acceptance(24)
+
+
+def test_cluster_audit_acceptance_200():
+    """The full ISSUE-19 acceptance scenario (slow lane)."""
+    _acceptance(200)

@@ -314,3 +314,78 @@ def test_agent_server_slo_public_flight_jwt_guarded():
             await client.close()
 
     run(scenario())
+
+
+# -- flood control (fan-out admission waves) ----------------------------------
+class TestFloodControl:
+    def test_sampling_keeps_one_in_n_and_counts_suppressed(self):
+        rec = FlightRecorder(capacity=64, dump_interval_s=1e9)
+        rec.set_sample_rate("admission", 8)
+        for i in range(80):
+            rec.record("admission", i=i)
+        kept = rec.snapshot(kind="admission")
+        assert [e["i"] for e in kept] == list(range(0, 80, 8))
+        stats = rec.stats()
+        assert stats["sampled_out"]["admission"] == 70
+        assert stats["sample_rates"]["admission"] == 8
+        # Unsampled kinds are untouched.
+        rec.record("restart", note="x")
+        assert len(rec.snapshot(kind="restart")) == 1
+
+    def test_flood_cannot_wrap_anomaly_context_out_of_the_ring(self):
+        """A fan-out's admission wave (10k events) against a 128-slot
+        ring: without sampling the wave evicts everything that explains
+        the run; with 1-in-256 sampling the earlier context survives."""
+        rec = FlightRecorder(capacity=128, dump_interval_s=1e9)
+        rec.record("restart", note="the context worth keeping")
+        rec.set_sample_rate("admission", 256)
+        for i in range(10_000):
+            rec.record("admission", i=i)
+        assert len(rec.snapshot(kind="admission")) == 40  # ceil(10k/256)
+        assert rec.snapshot(kind="restart")  # not evicted
+        assert rec.stats()["dropped"] == 0   # ring never even wrapped
+
+    def test_anomaly_opens_a_retention_window(self):
+        rec = FlightRecorder(capacity=256, dump_interval_s=1e9)
+        rec.anomaly_hold_s = 60.0
+        rec.set_sample_rate("dispatch", 8)
+        for i in range(16):
+            rec.record("dispatch", i=i)      # sampled: 2 kept
+        assert len(rec.snapshot(kind="dispatch")) == 2
+        rec.anomaly("ttft_breach", request_id="req-1")
+        for i in range(16, 26):
+            rec.record("dispatch", i=i)      # inside the hold: all kept
+        kept = [e["i"] for e in rec.snapshot(kind="dispatch")]
+        assert kept == [0, 8] + list(range(16, 26))
+        # Window closed -> sampling resumes (white-box: expire the hold).
+        rec._retain_until = 0.0
+        before = len(rec.snapshot(kind="dispatch"))
+        for i in range(26, 42):
+            rec.record("dispatch", i=i)
+        after = len(rec.snapshot(kind="dispatch"))
+        assert after - before == 2
+
+    def test_rate_leq_one_restores_full_recording(self):
+        rec = FlightRecorder(capacity=64, dump_interval_s=1e9)
+        rec.set_sample_rate("admission", 4)
+        for i in range(8):
+            rec.record("admission", i=i)
+        rec.set_sample_rate("admission", 0)
+        for i in range(8, 12):
+            rec.record("admission", i=i)
+        kept = [e["i"] for e in rec.snapshot(kind="admission")]
+        assert kept == [0, 4, 8, 9, 10, 11]
+        assert "admission" not in rec.stats()["sample_rates"]
+
+    def test_env_spec_parsed_and_reset_reparses(self, monkeypatch):
+        monkeypatch.setenv(
+            "OPSAGENT_FLIGHT_SAMPLE", "admission=8, dispatch=16,junk,x=1"
+        )
+        rec = FlightRecorder(capacity=32, dump_interval_s=1e9)
+        assert rec.stats()["sample_rates"] == {
+            "admission": 8, "dispatch": 16,
+        }
+        monkeypatch.setenv("OPSAGENT_FLIGHT_SAMPLE", "ttft=4")
+        rec.reset()
+        assert rec.stats()["sample_rates"] == {"ttft": 4}
+        assert rec.stats()["sampled_out"] == {}

@@ -240,3 +240,39 @@ def test_ragged_sweep_rows_gate_higher_better(tmp_path):
         _row("paged_decode_throughput[bench-8b,int8,B=32,tpu]", 1899.0),
     ])
     assert run_perf_check(fresh, baseline=base) == 0
+
+
+def test_audit_fanout_units_gate_in_the_right_direction(tmp_path):
+    """audit_latency_s is lower-better (a slower audit regresses);
+    prefix_hit_rate is higher-better (children re-prefilling the shared
+    prefix regresses)."""
+    base = _jsonl(tmp_path / "base.jsonl", [
+        _row("audit_fanout[tiny,N=64,R=2,cpu]", 10.0,
+             unit="audit_latency_s"),
+        _row("audit_fanout_prefix_hit[tiny,N=64,R=2,cpu]", 1.0,
+             unit="prefix_hit_rate"),
+    ])
+    # Latency up 50 % -> regression even though the value "went up".
+    cur = _jsonl(tmp_path / "slow.jsonl", [
+        _row("audit_fanout[tiny,N=64,R=2,cpu]", 15.0,
+             unit="audit_latency_s"),
+        _row("audit_fanout_prefix_hit[tiny,N=64,R=2,cpu]", 1.0,
+             unit="prefix_hit_rate"),
+    ])
+    assert run_perf_check(cur, baseline=base) == 1
+    # Hit rate collapsing -> regression even though latency held.
+    cur2 = _jsonl(tmp_path / "cold.jsonl", [
+        _row("audit_fanout[tiny,N=64,R=2,cpu]", 10.0,
+             unit="audit_latency_s"),
+        _row("audit_fanout_prefix_hit[tiny,N=64,R=2,cpu]", 0.4,
+             unit="prefix_hit_rate"),
+    ])
+    assert run_perf_check(cur2, baseline=base) == 1
+    # Both healthy (small wobble) -> pass.
+    cur3 = _jsonl(tmp_path / "ok.jsonl", [
+        _row("audit_fanout[tiny,N=64,R=2,cpu]", 9.5,
+             unit="audit_latency_s"),
+        _row("audit_fanout_prefix_hit[tiny,N=64,R=2,cpu]", 0.98,
+             unit="prefix_hit_rate"),
+    ])
+    assert run_perf_check(cur3, baseline=base) == 0
