@@ -1257,8 +1257,13 @@ def run_ragged_sweep(platform, n_chips, model, batch, steps,
                      prompt_len) -> None:
     """Ragged-backend sweep (ROADMAP item 1): time the MIXED hot path —
     sync ``step_mixed`` ticks, the program serving actually runs — across
-    attention backend x KV page dtype x weight quant cells on one model
-    shape, one self-describing tok/s/chip row per cell.
+    attention backend x KV page dtype x weight quant x weight-stream
+    cells on one model shape, one self-describing tok/s/chip row per
+    cell. The weight-stream axis rides the xla attention backend only
+    (the double-buffered quant-matmul prefetch is orthogonal to the
+    attention kernel under test) and needs quantized weights, so it adds
+    one pallas-dma cell per quantized weight mode — plus, off-chip, the
+    int8 oracle cell that anchors its byte-identity check.
 
     Each cell builds its own engine (the backend env var and quant modes
     are engine-construction inputs), warms exactly the mixed program
@@ -1310,14 +1315,26 @@ def run_ragged_sweep(platform, n_chips, model, batch, steps,
     sampling = SamplingParams(temperature=0.0, max_tokens=10**9)
 
     cells = [
-        (wq, kv, backend)
+        (wq, kv, backend, "xla", False)
         for wq in weight_modes for kv in kv_modes for backend in backends
     ]
+    # Weight-stream axis: one pallas-dma prefetch cell per quantized
+    # weight mode (xla attention, plain KV — the weight path is the axis
+    # under test). The prefetch kernel is single-shard for now, so these
+    # cells pin tp=1 and bring their OWN tp=1 xla oracle: greedy byte
+    # identity is only meaningful against the same reduction layout, and
+    # the baseline grid above runs on every chip.
+    ws_weights = ("int8", "int4") if on_tpu else ("int8",)
+    for wq in ws_weights:
+        cells.append((wq, "", "xla", "xla", True))
+        cells.append((wq, "", "xla", "pallas-dma", True))
     rows: list[dict] = []
     oracle: dict[tuple, list[list[int]]] = {}
     groups_ok: dict[tuple, bool] = {}
-    for wq, kv, backend in cells:
+    for wq, kv, backend, ws, single in cells:
         label = f"{backend}/{wq or 'bf16'}/kv-{kv or 'bf16'}"
+        if single:
+            label += f"/ws-{ws}"
         elapsed = time.perf_counter() - t_start
         if rows and elapsed > budget:
             log(f"bench[ragged-sweep]: {elapsed:.0f}s > {budget:.0f}s "
@@ -1327,6 +1344,7 @@ def run_ragged_sweep(platform, n_chips, model, batch, steps,
         cfg = EngineConfig(
             model=model,
             dtype=dtype,
+            tp=1 if single else 0,
             max_batch_size=batch,
             num_pages=num_pages,
             page_size=page_size,
@@ -1334,6 +1352,7 @@ def run_ragged_sweep(platform, n_chips, model, batch, steps,
             prefill_buckets=(prompt_len,),
             quantize=wq,
             kv_quantize=kv,
+            weight_stream=ws,
             mixed_batching=True,
             async_depth=1,
             mixed_buckets=buckets,
@@ -1367,20 +1386,28 @@ def run_ragged_sweep(platform, n_chips, model, batch, steps,
         dt = time.perf_counter() - t0
         post_compiles = int(obs.POST_WARMUP_COMPILES.value() - compiles0)
         tok_s = produced / dt
-        tok_s_chip = tok_s / n_chips
+        cell_chips = 1 if single else n_chips
+        tok_s_chip = tok_s / cell_chips
         outputs = [list(eng.sequences[s].tokens) for s in ids]
-        group = (wq, kv)
-        if backend == "xla":
+        # tp=1 weight-stream cells form their own oracle group: greedy
+        # byte identity only holds within one reduction layout.
+        group = (wq, kv, single)
+        if backend == "xla" and ws == "xla":
             oracle[group] = outputs
             identical = True
         else:
             identical = outputs == oracle.get(group)
         groups_ok[group] = groups_ok.get(group, True) and identical
         info = eng.impl_info()
+        # ws lands in the metric only for the single-shard weight-stream
+        # cells (oracle + prefetch), so every pre-existing cell keeps its
+        # baseline-comparable metric name.
+        ws_tag = f",ws-{ws}" if single else ""
         row = {
             "metric": (
                 f"mixed_ragged_throughput[{model},{wq or 'bf16'},"
-                f"kv-{kv or 'bf16'},{backend},B={batch},{platform}]"
+                f"kv-{kv or 'bf16'},{backend}{ws_tag},B={batch},"
+                f"{platform}]"
             ),
             "value": round(tok_s_chip, 1),
             "unit": "tok/s/chip",
@@ -1388,6 +1415,7 @@ def run_ragged_sweep(platform, n_chips, model, batch, steps,
             "extra": {
                 "total_tok_s": round(tok_s, 1),
                 "requested_backend": backend,
+                "requested_weight_stream": ws,
                 **info,
                 "outputs_identical": identical,
                 "post_warmup_compiles": post_compiles,
@@ -1395,15 +1423,15 @@ def run_ragged_sweep(platform, n_chips, model, batch, steps,
                 "steps": steps,
                 "interpret": not on_tpu,
                 "paged_backend": info["attn_impl"],
-                "chips": n_chips,
+                "chips": cell_chips,
                 "platform": platform,
             },
         }
         print(json.dumps(row), flush=True)
         rows.append(row)
         log(f"bench[ragged-sweep/{label}]: resolved={info['attn_impl']} "
-            f"{tok_s_chip:.0f} tok/s/chip, identical={identical}, "
-            f"post-warmup compiles {post_compiles}")
+            f"ws={info['weight_stream']} {tok_s_chip:.0f} tok/s/chip, "
+            f"identical={identical}, post-warmup compiles {post_compiles}")
         for sid in ids:
             eng.finish(sid)
         del eng

@@ -24,7 +24,9 @@ LLM (reference pkg/llms/openai.go:69-103); there is no counterpart Go code.
 
 from __future__ import annotations
 
+import contextlib
 import math
+import threading
 from typing import Any, Callable
 
 import jax
@@ -469,14 +471,55 @@ def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
     return x
 
 
+# Trace-time weight-stream backend scope: "xla" (dequantize fused into
+# the matmul operand read) or "pallas-dma" (ops.quant_matmul_pallas —
+# weight tiles double-buffered HBM->VMEM under the dot). Thread-local
+# like the jit trace itself; set by mixed_step/decode_step from the
+# engine's RESOLVED EngineConfig.weight_stream so every _mm under the
+# layer scan dispatches without threading a parameter through each
+# helper (same trace-time-read pattern as ops.attention.pallas_interpret).
+_WS_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def weight_stream_scope(impl: str):
+    """Activate a weight-stream backend for the ops traced inside."""
+    prev = getattr(_WS_TLS, "impl", "xla")
+    _WS_TLS.impl = impl or "xla"
+    try:
+        yield
+    finally:
+        _WS_TLS.impl = prev
+
+
+def _weight_stream_impl() -> str:
+    return getattr(_WS_TLS, "impl", "xla")
+
+
 def _mm(x: jax.Array, w: Any) -> jax.Array:
     """Matmul against a plain array or a weight-only quantized leaf
     (models.quant, any width): the dequantize multiplies fuse into the
     matmul operand read under XLA, so quantized weights stream from HBM
-    in their narrow storage type."""
+    in their narrow storage type. Under an active
+    ``weight_stream_scope("pallas-dma")``, 2D quantized leaves (the
+    per-layer scan slices plus lm_head) route through the Pallas
+    double-buffered weight-streaming kernel instead; stacked/MoE leaves
+    and plain arrays keep the XLA path."""
     from .quant import QuantizedBase
 
     if isinstance(w, QuantizedBase):
+        if _weight_stream_impl() == "pallas-dma":
+            from ..ops import quant_matmul_pallas as qmp
+
+            if qmp.supports(w):
+                from ..ops.attention import pallas_interpret
+
+                lead = x.shape[:-1]
+                y = qmp.quant_matmul_pallas(
+                    x.reshape(-1, x.shape[-1]), w,
+                    interpret=pallas_interpret(),
+                )
+                return y.reshape(*lead, y.shape[-1])
         return x @ w.dequantize().astype(x.dtype)
     return x @ w
 
@@ -1005,6 +1048,7 @@ def mixed_step(
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",  # ops.paged_attention_backend choice
     mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
+    weight_stream: str = "xla",  # xla | pallas-dma (quant_matmul_pallas)
 ) -> tuple[jax.Array, Params]:
     """The unified mixed prefill+decode forward: one program advances
     q_len=1 decode rows AND q_len=chunk prefill rows in the same batch, so
@@ -1044,11 +1088,12 @@ def mixed_step(
         )
         return attn.reshape(B, S, -1), kc, vc
 
-    x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    last = jnp.clip(q_lens - 1, 0, S - 1)
-    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
-    logits = _lm_head(params, cfg, x_last)
+    with weight_stream_scope(weight_stream):
+        x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        last = jnp.clip(q_lens - 1, 0, S - 1)
+        x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+        logits = _lm_head(params, cfg, x_last)
     return logits, cache
 
 
@@ -1114,6 +1159,7 @@ def decode_step(
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",  # xla | pallas | pallas-dma (paged_attention_backend)
     mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
+    weight_stream: str = "xla",  # xla | pallas-dma (quant_matmul_pallas)
 ) -> tuple[jax.Array, Params]:
     """One decode step for a batch of sequences; returns ([B, V] logits,
     updated cache)."""
@@ -1145,9 +1191,10 @@ def decode_step(
         )
         return attn.reshape(B, 1, -1), kc, vc
 
-    x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = _lm_head(params, cfg, x[:, 0])
+    with weight_stream_scope(weight_stream):
+        x, cache, _ = _run_stack(params, cfg, x, attn_fn, cache)
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        logits = _lm_head(params, cfg, x[:, 0])
     return logits, cache
 
 

@@ -227,12 +227,10 @@ def paged_decode_attention_auto(
     """Impl-dispatched paged decode attention (impl from
     ``paged_attention_backend``, resolved at trace time by the caller).
     With a mesh whose tp axis is >1, the Pallas path runs shard_mapped
-    over tp (see ``paged_decode_attention_pallas_tp``)."""
-    if isinstance(k_pages, QuantizedPages) and impl != "pallas-dma":
-        # int8+scale pages flow through the XLA gather or the manual-DMA
-        # kernel (which streams int8 pages and dequantizes in VMEM); the
-        # (B, MaxP) grid kernel has no scale path.
-        impl = "xla"
+    over tp (see ``paged_decode_attention_pallas_tp``). int8+scale
+    ``QuantizedPages`` flow through EVERY impl: the XLA gather, the
+    manual-DMA kernel, and the (B, MaxP) grid kernel all carry a
+    score-space scale path now."""
     if impl.startswith("pallas"):
         interpret = pallas_interpret()
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
@@ -586,17 +584,11 @@ def paged_ragged_attention_auto(
 ) -> jax.Array:
     """Impl-dispatched ragged paged attention (the mixed-step analogue of
     ``paged_decode_attention_auto``). "pallas-dma" dispatches to the
-    ragged manual-DMA streamer (``paged_ragged_attention_pallas_dma``),
-    which natively streams int8 ``QuantizedPages`` at half the bytes —
-    quantized pages on the mixed hot path are never materialized as a
-    dequantized contiguous gather. Only the (B, MaxP) grid kernel still
-    falls back to the XLA gather for quantized pages (it has no scale
-    path)."""
-    if isinstance(k_pages, QuantizedPages) and impl != "pallas-dma":
-        # int8+scale pages flow through the XLA gather or the ragged
-        # manual-DMA kernel (which streams int8 pages and applies scales
-        # in score space); the (B, MaxP) grid kernel has no scale path.
-        impl = "xla"
+    ragged manual-DMA streamer (``paged_ragged_attention_pallas_dma``)
+    and "pallas" to the (B, MaxP) grid kernel — BOTH natively stream
+    int8 ``QuantizedPages`` at half the bytes with score-space scales,
+    so quantized pages on the mixed hot path are never materialized as a
+    dequantized contiguous gather under any pallas impl."""
     if impl.startswith("pallas"):
         interpret = pallas_interpret()
         if mesh is not None and mesh.shape.get("tp", 1) > 1:

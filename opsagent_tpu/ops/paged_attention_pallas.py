@@ -54,19 +54,23 @@ def _kernel(
     table_ref,     # [B, MaxP] int32 page indices (-1 = unassigned)
     lengths_ref,   # [B] int32 tokens in cache (incl. the one being written)
     base_ref,      # [1] int32 flat-page offset (layer * N; 0 without layers)
-    # blocks
-    q_ref,         # [1, H, D]
-    k_ref,         # [1, P, K, D]   (one page, all kv heads)
-    v_ref,         # [1, P, K, D]
-    o_ref,         # [1, H, D]
-    # scratch
-    acc_ref,       # [H, D]  f32
-    m_ref,         # [H, 128] f32 (running max, lane-broadcast)
-    l_ref,         # [H, 128] f32 (running denominator)
-    *,
+    # blocks + scratch, order depending on ``quantized``:
+    #   q_ref [1, H, D]; k_ref/v_ref [1, P, K, D] (one page, all kv heads);
+    #   with quantized, k_sc_ref/v_sc_ref [1, 1, P*K] (this page's
+    #   pre-gathered f32 scale plane); o_ref [1, H, D]; then scratch
+    #   acc [H, D] f32, m/l [H, 128] f32 (running max / denominator,
+    #   lane-broadcast).
+    *refs,
     page_size: int,
     num_kv_heads: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        (q_ref, k_ref, v_ref, k_sc_ref, v_sc_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        k_sc_ref = v_sc_ref = None
     b = pl.program_id(0)
     p = pl.program_id(1)
     P = page_size
@@ -94,11 +98,22 @@ def _kernel(
         q = q_ref[0].astype(jnp.float32) * scale           # [H, D]
         kf = k_ref[0].reshape(P * K, D)                    # [P*K, D] row p*K+k
         vf = v_ref[0].reshape(P * K, D)
+        if quantized:
+            # int8 values <= 127 are exact in f32; the MXU dot runs on
+            # converted operands rather than a mixed int8 x f32 dot.
+            kf = kf.astype(jnp.float32)
         s_full = jax.lax.dot_general(
             q, kf,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                  # [H, P*K]
+        if quantized:
+            # Column c = (token c//K, kv head c%K) — the flat scale
+            # plane's exact order, so applying the K scale in score space
+            # is a lane-wise multiply identical to dequantizing the page
+            # (the scale is constant per column). Same math as the
+            # manual-DMA kernels (_kernel_dma).
+            s_full = s_full * k_sc_ref[0, 0][None, :]
         # Column c holds (token p*P + c//K, kv head c%K). Mask columns whose
         # kv head is not this query head's group (and out-of-range tokens) to
         # -inf and run the online softmax directly in the [H, P*K] domain —
@@ -115,8 +130,12 @@ def _kernel(
         alpha = jnp.exp(m_prev - m_new)                    # [H, 1]
         probs = jnp.exp(s - m_new)                         # [H, P*K]
         l_new = alpha[:, 0] * l_ref[:, 0] + jnp.sum(probs, axis=-1)
+        pv = probs
+        if quantized:
+            # V scale folds into the probs the same way (per-column).
+            pv = probs * v_sc_ref[0, 0][None, :]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            probs, vf.astype(jnp.float32),
+            pv, vf.astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -139,6 +158,17 @@ def _page_index(b, p, table_ref, lengths_ref, base_ref, *, page_size):
     last = jnp.maximum(num_pages - 1, 0)
     page = table_ref[b, jnp.minimum(p, last)]
     return (jnp.maximum(page, 0) + base_ref[0], 0, 0, 0)
+
+
+def _scale_index(b, p, table_ref, lengths_ref, base_ref, *, page_size):
+    """Block index into the pre-gathered ``[B, MaxP, P*K]`` scale planes
+    for grid step (b, p): the slot axis is clamped exactly like
+    ``_page_index`` so past-the-end steps see an unchanged index and the
+    pipeline skips the refetch — the scale block can therefore never come
+    from a different page slot than the k/v blocks beside it."""
+    num_pages = pl.cdiv(lengths_ref[b], page_size)
+    last = jnp.maximum(num_pages - 1, 0)
+    return (b, jnp.minimum(p, last), 0)
 
 
 def _kernel_dma(
@@ -408,18 +438,15 @@ def _kernel_ragged(
     start_ref,     # [B] int32 tokens already in cache (queries begin here)
     qlens_ref,     # [B] int32 valid query rows (0 = inactive row)
     base_ref,      # [1] int32 flat-page offset (layer * N; 0 without layers)
-    # blocks
-    q_ref,         # [1, S, H, D]
-    k_ref,         # [1, P, K, D]   (one page, all kv heads)
-    v_ref,         # [1, P, K, D]
-    o_ref,         # [1, S, H, D]
-    # scratch
-    acc_ref,       # [S*H, D]  f32
-    m_ref,         # [S*H, 128] f32 (running max, lane-broadcast)
-    l_ref,         # [S*H, 128] f32 (running denominator)
-    *,
+    # blocks + scratch, order depending on ``quantized``:
+    #   q_ref [1, S, H, D]; k_ref/v_ref [1, P, K, D] (one page, all kv
+    #   heads); with quantized, k_sc_ref/v_sc_ref [1, 1, P*K] (this
+    #   page's pre-gathered f32 scale plane); o_ref [1, S, H, D]; then
+    #   scratch acc [S*H, D] f32, m/l [S*H, 128] f32.
+    *refs,
     page_size: int,
     num_kv_heads: int,
+    quantized: bool = False,
 ):
     """Ragged-query sibling of ``_kernel``: S query rows per sequence with
     a per-row valid count, so q_len=1 decode rows and q_len=chunk prefill
@@ -428,7 +455,19 @@ def _kernel_ragged(
     causal-inside-the-chunk mask composes with the GQA group select in the
     same [S*H, P*K] score domain the decode kernel uses. Fully-masked rows
     (s >= q_len, or a q_len=0 row) keep finite accumulators (exp(0)
-    columns) and emit garbage the host discards."""
+    columns) and emit garbage the host discards.
+
+    ``quantized``: pages are int8 and two extra blocks carry this page
+    slot's pre-gathered, pre-flattened [1, 1, P*K] f32 scale planes,
+    pipelined with the SAME clamped slot index map as the pages; scales
+    apply as per-column multiplies in score/probs space exactly like the
+    manual-DMA kernels (see ``_kernel_dma``)."""
+    if quantized:
+        (q_ref, k_ref, v_ref, k_sc_ref, v_sc_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        k_sc_ref = v_sc_ref = None
     b = pl.program_id(0)
     p = pl.program_id(1)
     P = page_size
@@ -454,11 +493,16 @@ def _kernel_ragged(
         q = q_ref[0].reshape(S * H, D).astype(jnp.float32) * scale
         kf = k_ref[0].reshape(P * K, D)
         vf = v_ref[0].reshape(P * K, D)
+        if quantized:
+            kf = kf.astype(jnp.float32)
         s_full = jax.lax.dot_general(
             q, kf,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                  # [S*H, P*K]
+        if quantized:
+            # Per-column K scale in score space (see _kernel_dma).
+            s_full = s_full * k_sc_ref[0, 0][None, :]
         # Column c holds (token p*P + c//K, kv head c%K); row r holds
         # (query position start + r//H, query head r%H). Select the GQA
         # group AND the ragged causal window in one mask.
@@ -479,8 +523,12 @@ def _kernel_ragged(
         alpha = jnp.exp(m_prev - m_new)                    # [S*H, 1]
         probs = jnp.exp(s - m_new)                         # [S*H, P*K]
         l_new = alpha[:, 0] * l_ref[:, 0] + jnp.sum(probs, axis=-1)
+        pv = probs
+        if quantized:
+            # V scale folds into the probs the same way (per-column).
+            pv = probs * v_sc_ref[0, 0][None, :]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            probs, vf.astype(jnp.float32),
+            pv, vf.astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -509,6 +557,16 @@ def _page_index_ragged(
     return (jnp.maximum(page, 0) + base_ref[0], 0, 0, 0)
 
 
+def _scale_index_ragged(
+    b, p, table_ref, start_ref, qlens_ref, base_ref, *, page_size
+):
+    """``_scale_index`` for the ragged kernel (valid page count from
+    start + q_len)."""
+    num_pages = pl.cdiv(start_ref[b] + qlens_ref[b], page_size)
+    last = jnp.maximum(num_pages - 1, 0)
+    return (b, jnp.minimum(p, last), 0)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_ragged_attention_pallas(
     q: jax.Array,           # [B, S, H, D] right-padded ragged queries
@@ -527,11 +585,28 @@ def paged_ragged_attention_pallas(
     Attention). VMEM cost scales with S (q block + [S*H, D] f32
     accumulator), so S should stay a modest mixed-chunk bucket, not a
     full prefill bucket. Correctness oracle:
-    ``ops.attention.paged_ragged_attention``."""
+    ``ops.attention.paged_ragged_attention``.
+
+    Accepts ``ops.attention.QuantizedPages``: int8 pages flow through the
+    same per-page BlockSpec pipeline at half the bytes, while each page
+    slot's f32 scale plane — XLA-gathered outside, flattened to
+    [B, MaxP, P*K], and pipelined with the SAME clamped slot index map as
+    the pages — applies as per-column multiplies in score/probs space
+    (see ``_kernel_ragged``). This closes the sweep gap where
+    pallas + int8 KV silently resolved to xla at engine init."""
+    from .attention import QuantizedPages
+
+    k_scale = v_scale = None
+    if isinstance(k_pages, QuantizedPages):
+        k_pages, k_scale = k_pages.q, k_pages.scale
+        v_pages, v_scale = v_pages.q, v_pages.scale
     if k_pages.ndim == 5:
         Lr, N, P, K, D = k_pages.shape
         k_pages = k_pages.reshape(Lr * N, P, K, D)
         v_pages = v_pages.reshape(Lr * N, P, K, D)
+        if k_scale is not None:
+            k_scale = k_scale.reshape(Lr * N, P, K)
+            v_scale = v_scale.reshape(Lr * N, P, K)
         base = (layer if layer is not None else 0) * N
     else:
         N, P, K, D = k_pages.shape
@@ -539,19 +614,38 @@ def paged_ragged_attention_pallas(
     B, S, H, _ = q.shape
     MaxP = page_table.shape[1]
     base_arr = jnp.full((1,), base, jnp.int32)
+    quantized = k_scale is not None
 
     page_map = functools.partial(_page_index_ragged, page_size=P)
+    in_specs = [
+        pl.BlockSpec(
+            (1, S, H, D), lambda b, p, t, st, ql, ba: (b, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        # Per-page scale planes, gathered OUTSIDE the kernel (4 bytes per
+        # D int8 values) with the same max(slot, 0) + base index math as
+        # the page maps, flattened so the lane dim is 128-aligned, and
+        # pipelined one page slot at a time alongside the k/v blocks.
+        safe_table = jnp.maximum(page_table, 0) + base
+        sc_map = functools.partial(_scale_index_ragged, page_size=P)
+        sc_spec = pl.BlockSpec(
+            (1, 1, P * K), sc_map, memory_space=pltpu.VMEM
+        )
+        in_specs += [sc_spec, sc_spec]
+        operands += [
+            k_scale[safe_table].reshape(B, MaxP, P * K),
+            v_scale[safe_table].reshape(B, MaxP, P * K),
+        ]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B, MaxP),
-        in_specs=[
-            pl.BlockSpec(
-                (1, S, H, D), lambda b, p, t, st, ql, ba: (b, 0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, S, H, D), lambda b, p, t, st, ql, ba: (b, 0, 0, 0),
             memory_space=pltpu.VMEM,
@@ -563,7 +657,10 @@ def paged_ragged_attention_pallas(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel_ragged, page_size=P, num_kv_heads=K),
+        functools.partial(
+            _kernel_ragged, page_size=P, num_kv_heads=K,
+            quantized=quantized,
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
         interpret=interpret,
@@ -578,7 +675,7 @@ def paged_ragged_attention_pallas(
     )(
         page_table.astype(jnp.int32), start.astype(jnp.int32),
         q_lens.astype(jnp.int32), base_arr,
-        q, k_pages, v_pages,
+        *operands,
     )
     return out
 
@@ -866,6 +963,17 @@ def paged_decode_attention_pallas(
     interpret: bool = False,
     layer: jax.Array | None = None,  # [] int32 with the layer-axis form
 ) -> jax.Array:
+    """Grid-form paged decode attention. Accepts
+    ``ops.attention.QuantizedPages`` exactly like the ragged grid kernel:
+    int8 pages ride the per-page BlockSpec pipeline at half the bytes,
+    per-page [1, 1, P*K] scale planes ride beside them on the same
+    clamped slot index map, applied in score/probs space."""
+    from .attention import QuantizedPages
+
+    k_scale = v_scale = None
+    if isinstance(k_pages, QuantizedPages):
+        k_pages, k_scale = k_pages.q, k_pages.scale
+        v_pages, v_scale = v_pages.q, v_pages.scale
     if k_pages.ndim == 5:
         # Whole-cache form: flatten [L, N] -> [L*N] pages (free reshape) and
         # offset the scalar-prefetched page lookups by layer * N, so the
@@ -873,6 +981,9 @@ def paged_decode_attention_pallas(
         Lr, N, P, K, D = k_pages.shape
         k_pages = k_pages.reshape(Lr * N, P, K, D)
         v_pages = v_pages.reshape(Lr * N, P, K, D)
+        if k_scale is not None:
+            k_scale = k_scale.reshape(Lr * N, P, K)
+            v_scale = v_scale.reshape(Lr * N, P, K)
         base = (layer if layer is not None else 0) * N
     else:
         N, P, K, D = k_pages.shape
@@ -880,19 +991,33 @@ def paged_decode_attention_pallas(
     B, H, _ = q.shape
     MaxP = page_table.shape[1]
     base_arr = jnp.full((1,), base, jnp.int32)
+    quantized = k_scale is not None
 
     page_map = functools.partial(_page_index, page_size=P)
+    in_specs = [
+        pl.BlockSpec(
+            (1, H, D), lambda b, p, t, ln, ba: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        safe_table = jnp.maximum(page_table, 0) + base
+        sc_map = functools.partial(_scale_index, page_size=P)
+        sc_spec = pl.BlockSpec(
+            (1, 1, P * K), sc_map, memory_space=pltpu.VMEM
+        )
+        in_specs += [sc_spec, sc_spec]
+        operands += [
+            k_scale[safe_table].reshape(B, MaxP, P * K),
+            v_scale[safe_table].reshape(B, MaxP, P * K),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, MaxP),
-        in_specs=[
-            pl.BlockSpec(
-                (1, H, D), lambda b, p, t, ln, ba: (b, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, P, K, D), page_map, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, H, D), lambda b, p, t, ln, ba: (b, 0, 0),
             memory_space=pltpu.VMEM,
@@ -904,7 +1029,9 @@ def paged_decode_attention_pallas(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, page_size=P, num_kv_heads=K),
+        functools.partial(
+            _kernel, page_size=P, num_kv_heads=K, quantized=quantized,
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
@@ -918,6 +1045,6 @@ def paged_decode_attention_pallas(
         ),
     )(
         page_table.astype(jnp.int32), lengths.astype(jnp.int32), base_arr,
-        q, k_pages, v_pages,
+        *operands,
     )
     return out

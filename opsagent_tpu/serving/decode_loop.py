@@ -177,6 +177,7 @@ def mixed_step_carry(
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
     mesh=None,
+    weight_stream: str = "xla",  # llama.weight_stream_scope backend
     # Device-side constrained decoding, same table layout as
     # decode_block_carry: row 0 of fsm_mask/fsm_dest is the FREE sentinel,
     # DFA state s lives at row s+1. carry_fsm rides the dispatch chain;
@@ -204,6 +205,7 @@ def mixed_step_carry(
     logits, cache = llama.mixed_step(
         params, cfg, tokens, starts, q_lens, cache, page_table,
         dtype=dtype, attn_impl=attn_impl, mesh=mesh,
+        weight_stream=weight_stream,
     )
     with_fsm = fsm_mask is not None
     if with_fsm:
@@ -239,6 +241,7 @@ def decode_block(
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
     mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
+    weight_stream: str = "xla",  # llama.weight_stream_scope backend
 ) -> tuple[jax.Array, Any, jax.Array]:
     """One self-contained block: ``decode_block_carry`` with every lane
     host-initialized (override all) and the carry discarded. Returns
@@ -258,6 +261,7 @@ def decode_block(
         temps=temps, top_k=top_k, top_p=top_p,
         eos_id=eos_id, pad_id=pad_id, n_steps=n_steps, greedy=greedy,
         dtype=dtype, attn_impl=attn_impl, mesh=mesh,
+        weight_stream=weight_stream,
     )
     return toks, cache, key
 
@@ -288,6 +292,7 @@ def decode_block_carry(
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
     mesh=None,               # Mesh for the shard_mapped pallas-under-tp path
+    weight_stream: str = "xla",  # llama.weight_stream_scope backend
     # Device-side constrained decoding (SURVEY §7's hard part: the FSM
     # steps on device, no host sync per token). fsm_mask/fsm_dest are the
     # shared [S+1, V] tables — ROW 0 is the FREE sentinel (everything
@@ -328,6 +333,7 @@ def decode_block_carry(
         logits, cache = llama.decode_step(
             params, cfg, tok, at, cache, page_table, act,
             dtype=dtype, attn_impl=attn_impl, mesh=mesh,
+            weight_stream=weight_stream,
         )
         if with_fsm:
             # Grammar mask from the per-row DFA state: one [B, V] gather,

@@ -243,6 +243,18 @@ class EngineConfig:
     # config). Forces the xla paged-attention backend (the Pallas kernels
     # stream raw pages); unsupported for MLA latent caches.
     kv_quantize: str = ""
+    # Weight-stream backend for the quantized decode/mixed hot path: ""
+    # (resolve from $OPSAGENT_WEIGHT_STREAM, default "xla") or explicit
+    # "xla" / "pallas-dma". "pallas-dma" streams int8/int4 weight tiles
+    # HBM->VMEM through double-buffered DMA slots under the layer scan
+    # (ops.quant_matmul_pallas) so layer l+1's weights load during layer
+    # l's compute; "xla" keeps the fused dequantize-in-operand-read path.
+    # Default xla BY MEASUREMENT policy (same rule as the paged-attention
+    # backend): the ragged-sweep bench covers the axis, and the default
+    # flips only on on-chip evidence. Resolved ONCE at engine init (like
+    # attn_impl): requires quantized weights and tp == 1, else falls back
+    # to xla with a log line; the resolved value is in impl_info().
+    weight_stream: str = ""
     # Grammar-accelerated decoding: when a constrained row's FSM state
     # admits exactly ONE legal token (JSON punctuation, known key names,
     # enum close-quotes), emit the whole forced run with NO per-token
@@ -498,12 +510,42 @@ class Engine:
         self.sequences: dict[int, Sequence] = {}
         self._evictions_seen = 0  # delta-sync base for the obs counter
         self._sample_key = jax.random.PRNGKey(cfg.seed + 1)
+        # Weight-stream backend, resolved ONCE here like attn_impl below:
+        # the env knob records what was asked for; self.weight_stream_impl
+        # is what actually runs (impl_info / healthz / bench rows).
+        ws = cfg.weight_stream or os.environ.get(
+            "OPSAGENT_WEIGHT_STREAM", ""
+        ) or "xla"
+        if ws not in ("xla", "pallas-dma"):
+            raise ValueError(
+                f"weight_stream={ws!r}: expected 'xla' or 'pallas-dma'"
+            )
+        if ws == "pallas-dma" and cfg.quantize not in ("int8", "int4"):
+            # The kernel streams NARROW storage types; full-precision
+            # weights have nothing to dequantize in-register.
+            log.info(
+                "weight_stream=pallas-dma needs quantize=int8|int4 "
+                "(got %r): falling back to xla", cfg.quantize or "none",
+            )
+            ws = "xla"
+        if ws == "pallas-dma" and tp > 1:
+            # Row-parallel projections (wo, wd) would need a psum epilogue
+            # around the shard_mapped kernel; until that is wired and
+            # measured, sharded engines keep the XLA path.
+            log.info(
+                "weight_stream=pallas-dma is single-shard only for now "
+                "(tp=%d): falling back to xla", tp,
+            )
+            ws = "xla"
+        self.weight_stream_impl = ws
+        if ws != "xla":
+            log.info("weight stream impl: %s", ws)
         # Goodput ledger: the static roofline cost model pricing every
         # dispatch from its batch composition (obs/attribution.py). Pure
         # host float math — nothing here is jitted or device-resident, so
         # the zero-post-warmup-compiles invariant is untouched.
         self.attr = obs.attribution.Attribution.for_engine(
-            self.model_cfg, cfg
+            self.model_cfg, cfg, weight_stream=ws
         )
         obs.attribution.set_current(self.attr)
 
@@ -520,21 +562,12 @@ class Engine:
                 self.attn_impl,
             )
             self.attn_impl = "xla"
-        if cfg.kv_quantize and self.attn_impl not in ("xla", "pallas-dma"):
-            # int8 pages + scales flow through the XLA gather or the
-            # manual-DMA kernels — BOTH hot paths now: decode
-            # (paged_decode_attention_pallas_dma) and the mixed ragged
-            # step (paged_ragged_attention_pallas_dma) stream int8 pages
-            # at half the bytes with score-space scales. Only the
-            # (B, MaxP) grid kernel has no scale path, so only "pallas"
-            # falls back here.
-            log.info(
-                "kv_quantize=%s: forcing xla paged attention (was %s; "
-                "grid kernel has no scale path — pallas-dma streams int8 "
-                "pages natively)",
-                cfg.kv_quantize, self.attn_impl,
-            )
-            self.attn_impl = "xla"
+        # kv_quantize no longer forces a backend: int8 pages + scales flow
+        # through ALL impls — the XLA gather, the manual-DMA kernels, AND
+        # the (B, MaxP) grid kernels (score-space scale path) — so the
+        # requested backend resolves as asked and the ragged sweep's
+        # pallas+int8KV cell measures the grid kernel, not a silent xla
+        # fallback.
         from ..ops.attention import pallas_interpret
 
         if (
@@ -593,6 +626,7 @@ class Engine:
             logits, cache = llama.decode_step(
                 params, mc, tokens, lengths, cache, table, active, dtype=dt,
                 attn_impl=self.attn_impl, mesh=self.mesh,
+                weight_stream=self.weight_stream_impl,
             )
             if bias is not None:
                 logits = logits + bias
@@ -612,6 +646,7 @@ class Engine:
             logits, cache = llama.decode_step(
                 params, mc, tokens, lengths, cache, table, active, dtype=dt,
                 attn_impl=self.attn_impl, mesh=self.mesh,
+                weight_stream=self.weight_stream_impl,
             )
             if bias is not None:
                 logits = logits + bias
@@ -643,6 +678,7 @@ class Engine:
             logits, cache = llama.mixed_step(
                 params, mc, tokens, starts, qlens, cache, table, dtype=dt,
                 attn_impl=self.attn_impl, mesh=self.mesh,
+                weight_stream=self.weight_stream_impl,
             )
             tok = sample(logits, key, temps, top_k, top_p, None)
             return tok.astype(jnp.int32), cache
@@ -668,6 +704,7 @@ class Engine:
                 dtype=dt,
                 attn_impl=self.attn_impl,
                 mesh=self.mesh,
+                weight_stream=self.weight_stream_impl,
             )
 
         self._prefill_jit = jax.jit(_prefill, donate_argnames=("cache",))
@@ -700,6 +737,7 @@ class Engine:
                 params, mc, tokens, use_carry, carry_tok, starts, qlens,
                 emits, cache, table, key, temps, top_k, top_p,
                 dtype=dt, attn_impl=self.attn_impl, mesh=self.mesh,
+                weight_stream=self.weight_stream_impl,
                 fsm_mask=fsm_mask, fsm_dest=fsm_dest,
                 carry_fsm=carry_fsm, ov_fsm=ov_fsm,
             )
@@ -835,16 +873,120 @@ class Engine:
 
     def impl_info(self) -> dict[str, str]:
         """The RESOLVED execution modes: attention impl after every
-        fallback gate (MLA, kv-quantize, head-dim alignment) plus weight
-        and KV quantization. Folded into ``/healthz`` and every bench
-        result line's ``extra`` so sweep rows and fleet snapshots are
-        self-describing — the env knob records what was ASKED for, this
-        records what actually runs."""
+        fallback gate (MLA, kv-quantize, head-dim alignment), the
+        weight-stream backend after ITS gates (quantize present, tp == 1),
+        plus weight and KV quantization. Folded into ``/healthz`` and
+        every bench result line's ``extra`` so sweep rows and fleet
+        snapshots are self-describing — the env knob records what was
+        ASKED for, this records what actually runs."""
         return {
             "attn_impl": self.attn_impl,
+            "weight_stream": self.weight_stream_impl,
             "quantize": self.cfg.quantize or "none",
             "kv_quantize": self.cfg.kv_quantize or "none",
         }
+
+    def _warmup_precompile_jobs(
+        self, progs: frozenset
+    ) -> list[tuple[str, Any]]:
+        """(group, thunk) jobs for warmup's parallel pre-compile pass:
+        each thunk is ``jit_fn.lower(args).compile()`` with the EXACT
+        concrete arrays the sequential dispatch loop will pass (same
+        avals, shardings, donation), so the executable it writes into the
+        persistent compilation cache is the one the dispatch loop reads
+        back. ``lower()`` only traces — nothing executes, no donated
+        buffer is consumed, and ``self.cache`` is untouched.
+
+        Only the straight-line program families are listed. The
+        carry-chained variants (mixed_async, ffwd, pipeline second call,
+        spec) take device OUTPUTS as inputs — their argument shardings
+        only exist after the first dispatch — so they stay sequential.
+        """
+        B = self.cfg.max_batch_size
+        MaxP = self.cfg.max_pages_per_seq
+        jobs: list[tuple[str, Any]] = []
+
+        def add(group: str, fn, *args, **kw):
+            jobs.append((group, lambda: fn.lower(*args, **kw).compile()))
+
+        drop1 = jnp.full((1, MaxP), -1, jnp.int32)
+        for bucket in self.cfg.prefill_buckets:
+            toks = jnp.zeros((1, bucket), jnp.int32)
+            ln = jnp.asarray([bucket], jnp.int32)
+            if "prefill" in progs:
+                add(
+                    "prefill", self._prefill_jit,
+                    self.params, toks, ln, self.cache, drop1,
+                )
+            if "prefill_prefix" in progs:
+                add(
+                    "prefill_prefix", self._prefill_prefix_jit,
+                    self.params, toks, jnp.asarray([0], jnp.int32), ln,
+                    self.cache, drop1,
+                )
+            if "prefill_batched" in progs:
+                ceil = 1
+                while ceil < self.cfg.prefill_batch:
+                    ceil *= 2
+                bp = 2
+                while bp <= ceil:
+                    add(
+                        "prefill_batched", self._prefill_prefix_jit,
+                        self.params,
+                        jnp.zeros((bp, bucket), jnp.int32),
+                        jnp.zeros((bp,), jnp.int32),
+                        jnp.zeros((bp,), jnp.int32),
+                        self.cache,
+                        jnp.full((bp, MaxP), -1, jnp.int32),
+                    )
+                    bp *= 2
+        dropB = jnp.full((B, MaxP), -1, jnp.int32)
+        zi = jnp.zeros((B,), jnp.int32)
+        zf = jnp.zeros((B,), jnp.float32)
+        of = jnp.ones((B,), jnp.float32)
+        inactive = jnp.zeros((B,), bool)
+        # Lowering only consumes the key's aval, so peeling a split off
+        # the live key WITHOUT advancing self._sample_key is safe here.
+        sub = jax.random.split(self._sample_key)[1]
+        if "mixed" in progs and self.cfg.mixed_batching:
+            for sb in self.cfg.mixed_buckets:
+                add(
+                    "mixed", self._mixed_sample_jit,
+                    self.params, jnp.zeros((B, sb), jnp.int32), zi, zi,
+                    self.cache, dropB, sub, zf, zi, of,
+                )
+        biasB = None
+        if "decode_single" in progs or "logprobs" in progs:
+            biasB = jnp.zeros((B, self.model_cfg.vocab_size), jnp.float32)
+        if "decode_single" in progs:
+            for b in (None, biasB):
+                add(
+                    "decode_single", self._decode_sample_jit,
+                    self.params, zi, zi, self.cache, dropB, inactive,
+                    sub, zf, zi, of, None, *(() if b is None else (b,)),
+                )
+        if "logprobs" in progs:
+            for b in (None, biasB):
+                add(
+                    "logprobs", self._decode_sample_lp_jit,
+                    self.params, zi, zi, self.cache, dropB, inactive,
+                    sub, zf, zi, of, None, b,
+                )
+        for greedy in (True, False):
+            if ("decode_greedy" if greedy else "decode_sampled") not in progs:
+                continue
+            add(
+                "decode_block", self._decode_pipeline_jit,
+                self.params,
+                jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), bool), sub,
+                jnp.zeros((B,), bool), zi, zi, inactive, zi,
+                self.cache, dropB, zf, zi, of,
+                greedy=greedy,
+                fsm_mask=None, fsm_dest=None,
+                carry_fsm=jnp.zeros((B,), jnp.int32), ov_fsm=zi,
+            )
+        return jobs
 
     def warmup(self, level: str = "full") -> float:
         """Compile serving programs ahead of the first request: each
@@ -875,6 +1017,60 @@ class Engine:
             # would otherwise desync lanes still referenced by pulls).
             self._async_settle()
             self._flush_and_invalidate()
+            # Parallel pre-compile (OPSAGENT_WARMUP_PARALLEL, default on):
+            # lower+compile the straight-line program families on a thread
+            # pool FIRST, so XLA builds them concurrently; the sequential
+            # dispatch loop below then reads each executable back from the
+            # persistent compilation cache instead of compiling serially.
+            # Gated on the cache being active — without it the AOT
+            # executables are unreachable from the dispatch path and the
+            # pass would compile everything twice. Worker-thread compiles
+            # still count as "warmup" to the compile watchdog: the
+            # warmup_phase bracket is a process-wide flag, not
+            # thread-local. Sub-threshold programs (compile faster than
+            # OPSAGENT_COMPILE_CACHE_MIN_S) recompile in the dispatch
+            # loop; by definition that re-pay is cheap.
+            par = os.environ.get("OPSAGENT_WARMUP_PARALLEL", "1") not in (
+                "", "0",
+            )
+            if par and self.compile_cache_dir:
+                jobs = self._warmup_precompile_jobs(progs)
+                if len(jobs) > 1:
+                    import concurrent.futures as _cf
+
+                    def _run(item):
+                        group, thunk = item
+                        jt0 = time.perf_counter()
+                        try:
+                            with self.mesh_ctx():
+                                thunk()
+                        except Exception:  # noqa: BLE001 - best-effort
+                            log.exception(
+                                "parallel warmup pre-compile failed for "
+                                "%s (non-fatal; sequential pass covers it)",
+                                group,
+                            )
+                        return group, time.perf_counter() - jt0
+
+                    workers = min(
+                        len(jobs), max(2, (os.cpu_count() or 4) // 2), 8
+                    )
+                    groups: dict[str, float] = dict(
+                        self.init_stats.get("warmup_groups", {})
+                    )
+                    with _cf.ThreadPoolExecutor(
+                        max_workers=workers, thread_name_prefix="warmup"
+                    ) as ex:
+                        for group, secs in ex.map(_run, jobs):
+                            groups[group] = round(
+                                groups.get(group, 0.0) + secs, 3
+                            )
+                    self.init_stats["warmup_groups"] = groups
+                    log.info(
+                        "parallel warmup pre-compile: %d programs on %d "
+                        "threads, per-group seconds %s",
+                        len(jobs), workers, groups,
+                    )
             drop1 = jnp.full((1, MaxP), -1, jnp.int32)
             logits = None
             for bucket in self.cfg.prefill_buckets:

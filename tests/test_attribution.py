@@ -152,7 +152,7 @@ def test_goodput_counter_and_snapshot():
     assert snap["dispatches"] == 1
     assert snap["bytes_total"] > 0
     assert set(snap["bytes_by_kind"]) == {
-        "weights", "kv_read", "kv_write", "other",
+        "weights", "weights_prefetch", "kv_read", "kv_write", "other",
     }
 
 

@@ -439,10 +439,11 @@ def test_fleet_chaos_mode_zero_failed_requests_under_faults():
 
 def test_ragged_sweep_mode_emits_per_backend_identical_rows():
     """OPSAGENT_BENCH_MODE=ragged-sweep (the mixed-hot-path backend
-    sweep) on CPU must run every (backend x KV dtype) cell through
-    interpret-mode Pallas, emit one tok/s/chip row per cell with the
-    RESOLVED impl in extra, verify byte-identical greedy output against
-    each group's xla cell, and end with the best-cell summary line."""
+    sweep) on CPU must run every (backend x KV dtype) cell plus the
+    weight-stream cells through interpret-mode Pallas, emit one
+    tok/s/chip row per cell with the RESOLVED impls in extra, verify
+    byte-identical greedy output against each group's xla cell, and end
+    with the best-cell summary line."""
     out = _run_bench({
         "JAX_PLATFORMS": "cpu",
         "OPSAGENT_BENCH_MODE": "ragged-sweep",
@@ -460,8 +461,9 @@ def test_ragged_sweep_mode_emits_per_backend_identical_rows():
             continue
         if isinstance(parsed, dict) and "metric" in parsed:
             rows.append(parsed)
-    # 3 backends x 2 KV dtypes (weight quant stays off-chip) + summary.
-    assert len(rows) == 7, [r["metric"] for r in rows]
+    # 3 backends x 2 KV dtypes (weight quant stays off-chip) + the int8
+    # weight-stream pair (xla oracle + pallas-dma prefetch) + summary.
+    assert len(rows) == 9, [r["metric"] for r in rows]
     cells = rows[:-1]
     for r in cells:
         assert r["unit"] == "tok/s/chip"
@@ -469,21 +471,33 @@ def test_ragged_sweep_mode_emits_per_backend_identical_rows():
         assert e["outputs_identical"] is True, r["metric"]
         assert e["post_warmup_compiles"] == 0, r["metric"]
         assert e["interpret"] is True
-        # Self-describing: resolved impl + quant modes ride every row.
+        # Self-describing: resolved impls + quant modes ride every row.
         assert e["attn_impl"] in ("xla", "pallas", "pallas-dma")
+        assert e["weight_stream"] in ("xla", "pallas-dma")
         assert e["kv_quantize"] in ("none", "int8")
     resolved = {(e["requested_backend"], e["kv_quantize"]): e["attn_impl"]
                 for e in (r["extra"] for r in cells)}
-    # pallas-dma streams int8 pages natively; the grid kernel has no
-    # scale path so its int8 cell resolves to the xla gather.
+    # Every Pallas impl carries a score-space scale path now, so the
+    # int8-KV cells keep their requested kernel instead of falling back.
     assert resolved[("pallas-dma", "int8")] == "pallas-dma"
-    assert resolved[("pallas", "int8")] == "xla"
+    assert resolved[("pallas", "int8")] == "pallas"
     assert resolved[("pallas", "none")] == "pallas"
+    # The weight-stream cells: requesting pallas-dma with int8 weights
+    # must RESOLVE to pallas-dma (quantized weights, tp=1 — no gate
+    # trips) and still be byte-identical to its group's xla oracle.
+    ws_rows = [
+        r for r in cells
+        if r["extra"]["requested_weight_stream"] == "pallas-dma"
+    ]
+    assert len(ws_rows) == 1, [r["metric"] for r in ws_rows]
+    assert ws_rows[0]["extra"]["weight_stream"] == "pallas-dma"
+    assert ws_rows[0]["extra"]["quantize"] == "int8"
+    assert ",ws-pallas-dma," in ws_rows[0]["metric"]
     # Summary last: best cell's value with the per-cell map folded in.
     summary = rows[-1]
-    assert summary["extra"]["cells"] == 6
+    assert summary["extra"]["cells"] == 8
     assert summary["value"] == max(r["value"] for r in cells)
-    assert len(summary["extra"]["cell_tok_s_chip"]) == 6
+    assert len(summary["extra"]["cell_tok_s_chip"]) == 8
 
 
 def test_audit_fanout_mode_reports_numbers():

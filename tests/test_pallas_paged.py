@@ -636,6 +636,119 @@ def test_ragged_dma_length_beyond_table_clamps():
     assert not np.isnan(np.asarray(got)).any()
 
 
+def _quantized_case(rng, B, S, H, K, D, P, MaxP, N, start, q_lens):
+    """int8 QuantizedPages filled through the real write path (per-token
+    absmax scales, like the engine), for the grid-kernel scale tests."""
+    from opsagent_tpu.ops.attention import QuantizedPages, write_kv_pages
+
+    q, _, _, table, st, ql = _make_ragged_case(
+        rng, B, S, H, K, D, P, MaxP, num_pages=N, start=start, q_lens=q_lens,
+    )
+    kq = QuantizedPages(
+        jnp.zeros((N, P, K, D), jnp.int8), jnp.ones((N, P, K), jnp.float32)
+    )
+    vq = QuantizedPages(
+        jnp.zeros((N, P, K, D), jnp.int8), jnp.ones((N, P, K), jnp.float32)
+    )
+    total = int(max(s + l for s, l in zip(start, q_lens)))
+    kw = jnp.asarray(rng.standard_normal((B, total, K, D)), jnp.float32)
+    vw = jnp.asarray(rng.standard_normal((B, total, K, D)), jnp.float32)
+    kq, vq = write_kv_pages(
+        kq, vq, kw, vw, table, jnp.zeros((B,), jnp.int32), valid_len=st + ql,
+    )
+    return q, kq, vq, table, st, ql
+
+
+@pytest.mark.parametrize(
+    "start,q_lens",
+    [
+        ([9, 0, 4], [1, 8, 0]),   # decode row + chunk + inactive row
+        ([13, 30, 0], [4, 2, 8]), # page-crossing chunks, fresh prompt
+    ],
+)
+def test_ragged_grid_quantized_matches_xla_reader(start, q_lens):
+    """int8 QuantizedPages through the plain-pallas RAGGED GRID kernel
+    (interpret): the score-space scale path (k scales multiply scores,
+    v scales multiply probabilities) must match the XLA ragged gather on
+    the SAME quantized cache — this is the cell the sweep previously
+    silently resolved to xla."""
+    from opsagent_tpu.ops.attention import paged_ragged_attention
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_ragged_attention_pallas,
+    )
+
+    rng = np.random.default_rng(31)
+    q, kq, vq, table, st, ql = _quantized_case(
+        rng, B=3, S=8, H=4, K=2, D=32, P=4, MaxP=10, N=32,
+        start=start, q_lens=q_lens,
+    )
+    ref = paged_ragged_attention(q, kq, vq, table, st, ql)
+    got = paged_ragged_attention_pallas(q, kq, vq, table, st, ql,
+                                        interpret=True)
+    for b, n in enumerate(q_lens):
+        if n:
+            np.testing.assert_allclose(
+                np.asarray(got)[b, :n], np.asarray(ref)[b, :n],
+                rtol=2e-5, atol=2e-5,
+            )
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_decode_grid_quantized_matches_xla_reader():
+    """int8 QuantizedPages through the plain-pallas DECODE grid kernel
+    (interpret) vs the XLA gather on the same quantized cache."""
+    from opsagent_tpu.ops.attention import paged_decode_attention
+    from opsagent_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_pallas,
+    )
+
+    rng = np.random.default_rng(32)
+    lengths = [5, 17, 1]
+    q, kq, vq, table, st, ql = _quantized_case(
+        rng, B=3, S=1, H=4, K=2, D=32, P=4, MaxP=8, N=26,
+        start=[n - 1 for n in lengths], q_lens=[1, 1, 1],
+    )
+    lens = jnp.asarray(lengths, jnp.int32)
+    ref = paged_decode_attention(q[:, 0], kq, vq, table, lens)
+    got = paged_decode_attention_pallas(
+        q[:, 0], kq, vq, table, lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_auto_dispatch_keeps_pallas_backend_for_quantized_pages(monkeypatch):
+    """The auto dispatchers no longer demote QuantizedPages to xla: with
+    OPSAGENT_PAGED_BACKEND=pallas the grid kernel runs (and matches the
+    gather), for both the decode and ragged entry points."""
+    from opsagent_tpu.ops.attention import (
+        paged_decode_attention, paged_decode_attention_auto,
+        paged_ragged_attention, paged_ragged_attention_auto,
+    )
+
+    monkeypatch.setenv("OPSAGENT_PAGED_BACKEND", "pallas")
+    monkeypatch.setenv("OPSAGENT_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(33)
+    q, kq, vq, table, st, ql = _quantized_case(
+        rng, B=2, S=8, H=4, K=2, D=32, P=4, MaxP=8, N=18,
+        start=[9, 0], q_lens=[1, 8],
+    )
+    ref = paged_ragged_attention(q, kq, vq, table, st, ql)
+    got = paged_ragged_attention_auto(q, kq, vq, table, st, ql)
+    for b, n in enumerate([1, 8]):
+        np.testing.assert_allclose(
+            np.asarray(got)[b, :n], np.asarray(ref)[b, :n],
+            rtol=2e-5, atol=2e-5,
+        )
+    lens = st + ql
+    ref_d = paged_decode_attention(q[:, 0], kq, vq, table, lens)
+    got_d = paged_decode_attention_auto(q[:, 0], kq, vq, table, lens)
+    np.testing.assert_allclose(
+        np.asarray(got_d), np.asarray(ref_d), rtol=2e-5, atol=2e-5
+    )
+
+
 @pytest.mark.slow
 def test_ragged_dma_at_bench_8b_mixed_shape():
     """Interpret parity at the EXACT bench-8b mixed decode-tick shape

@@ -242,6 +242,30 @@ def test_ragged_sweep_rows_gate_higher_better(tmp_path):
     assert run_perf_check(fresh, baseline=base) == 0
 
 
+def test_weight_stream_sweep_rows_gate_higher_better(tmp_path):
+    """The weight-stream prefetch cells (`,ws-pallas-dma,` in the
+    metric) are tok/s/chip rows like every other sweep cell: a prefetch
+    kernel that loses its overlap must fail the gate, a faster one must
+    pass, and the first run of a brand-new ws cell (no baseline twin)
+    must not gate at all."""
+    ws_cell = ("mixed_ragged_throughput[bench-8b,int8,kv-bf16,xla,"
+               "ws-pallas-dma,B=32,tpu]")
+    base = _jsonl(
+        tmp_path / "base.jsonl", BASELINE + [_row(ws_cell, 3000.0)]
+    )
+    slower = _jsonl(tmp_path / "cur.jsonl", [_row(ws_cell, 3000.0 * 0.7)])
+    assert run_perf_check(slower, baseline=base) == 1
+    faster = _jsonl(tmp_path / "cur2.jsonl", [_row(ws_cell, 3000.0 * 1.2)])
+    assert run_perf_check(faster, baseline=base) == 0
+    # int4 ws cell has no baseline twin yet: reported, never gated.
+    fresh = _jsonl(tmp_path / "cur3.jsonl", [
+        _row("mixed_ragged_throughput[bench-8b,int4,kv-bf16,xla,"
+             "ws-pallas-dma,B=32,tpu]", 3300.0),
+        _row(ws_cell, 3000.0),
+    ])
+    assert run_perf_check(fresh, baseline=base) == 0
+
+
 def test_audit_fanout_units_gate_in_the_right_direction(tmp_path):
     """audit_latency_s is lower-better (a slower audit regresses);
     prefix_hit_rate is higher-better (children re-prefilling the shared
